@@ -1,0 +1,14 @@
+"""racon-tpu: a TPU-native long-read consensus / assembly-polishing framework.
+
+Feature-parity re-design of lbcb-sci/racon (v1.5.0): reads + overlaps
+(MHAP/PAF/SAM) + draft targets in, polished contigs (or error-corrected
+fragments) out. The host runtime (parsing, data model, filtering, windowing,
+POA oracle, stitching) is native C++ (racon_tpu/native); the accelerated path
+runs batched banded alignment and batched partial-order alignment as JAX/
+Pallas kernels sharded over TPU meshes (racon_tpu/ops, racon_tpu/parallel).
+"""
+
+__version__ = "0.1.0"
+
+from .polisher import CpuPolisher, TpuPolisher, create_polisher  # noqa: F401
+from .pipeline import Pipeline  # noqa: F401

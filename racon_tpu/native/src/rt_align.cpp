@@ -1,0 +1,239 @@
+#include "rt_align.hpp"
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <limits>
+#include <vector>
+
+namespace rt {
+
+namespace {
+
+constexpr int32_t kInf = std::numeric_limits<int32_t>::max() / 4;
+
+// Append `count` copies of `op` to a CIGAR under construction (run-length).
+void push_op(std::string& cigar, char op, uint32_t count) {
+  if (count == 0) {
+    return;
+  }
+  cigar += std::to_string(count);
+  cigar += op;
+}
+
+}  // namespace
+
+// Banded unit-cost NW over diagonals d = j - i, d in [dmin, dmax].
+// Traceback moves: 0 = diag (M), 1 = left (D, consumes target),
+// 2 = up (I, consumes query). Directions are packed 4-per-byte.
+std::string align_global_cigar(const char* q, uint32_t q_len, const char* t,
+                               uint32_t t_len) {
+  if (q_len == 0 || t_len == 0) {
+    std::string cigar;
+    push_op(cigar, 'D', t_len);
+    push_op(cigar, 'I', q_len);
+    return cigar;
+  }
+
+  const int64_t diff = static_cast<int64_t>(t_len) - static_cast<int64_t>(q_len);
+  // One bit-parallel distance pass first: the exact distance gives an exact
+  // band, so the DP+traceback pass runs exactly once with no retries.
+  const int64_t dist_exact = edit_distance(q, q_len, t, t_len);
+  int64_t k = std::max<int64_t>(1, dist_exact);
+  const int64_t k_cap =
+      static_cast<int64_t>(std::max(q_len, t_len)) + 1;
+
+  std::vector<int32_t> prev_row, cur_row;
+  std::vector<uint8_t> tb;
+
+  while (true) {
+    const int64_t dmin = std::min<int64_t>(0, diff) - k;
+    const int64_t dmax = std::max<int64_t>(0, diff) + k;
+    const int64_t width = dmax - dmin + 1;
+
+    // Traceback storage: (q_len + 1) rows x width diagonals, 2 bits each.
+    const size_t tb_bytes =
+        (static_cast<size_t>(q_len + 1) * static_cast<size_t>(width) + 3) / 4;
+    if (tb_bytes > (3ull << 30)) {
+      std::fprintf(stderr,
+                   "[racon_tpu::align_global_cigar] error: alignment of "
+                   "%u x %u exceeds memory budget!\n",
+                   q_len, t_len);
+      std::exit(1);
+    }
+    tb.assign(tb_bytes, 0);
+    prev_row.assign(width, kInf);
+    cur_row.assign(width, kInf);
+
+    auto set_tb = [&](uint32_t i, int64_t w, uint8_t move) {
+      const size_t idx = static_cast<size_t>(i) * width + w;
+      tb[idx >> 2] |= move << ((idx & 3) << 1);
+    };
+
+    // Row 0: D[0][j] = j for j in band.
+    for (int64_t w = 0; w < width; ++w) {
+      const int64_t j = dmin + w;  // i == 0
+      if (j >= 0 && j <= static_cast<int64_t>(t_len)) {
+        prev_row[w] = static_cast<int32_t>(j);
+        if (j > 0) {
+          set_tb(0, w, 1);
+        }
+      }
+    }
+
+    for (uint32_t i = 1; i <= q_len; ++i) {
+      std::fill(cur_row.begin(), cur_row.end(), kInf);
+      const int64_t j_lo = std::max<int64_t>(0, dmin + i);
+      const int64_t j_hi = std::min<int64_t>(t_len, dmax + i);
+      for (int64_t j = j_lo; j <= j_hi; ++j) {
+        const int64_t w = j - i - dmin;
+        int32_t best;
+        uint8_t move;
+        if (j == 0) {
+          best = static_cast<int32_t>(i);
+          move = 2;
+        } else {
+          // Diagonal (same w in previous row).
+          const int32_t sub =
+              prev_row[w] == kInf
+                  ? kInf
+                  : prev_row[w] + (q[i - 1] == t[j - 1] ? 0 : 1);
+          best = sub;
+          move = 0;
+          // Left: consume target, w-1 in the same row.
+          if (w > 0 && cur_row[w - 1] != kInf && cur_row[w - 1] + 1 < best) {
+            best = cur_row[w - 1] + 1;
+            move = 1;
+          }
+          // Up: consume query, w+1 in the previous row.
+          if (w + 1 < width && prev_row[w + 1] != kInf &&
+              prev_row[w + 1] + 1 < best) {
+            best = prev_row[w + 1] + 1;
+            move = 2;
+          }
+        }
+        cur_row[w] = best;
+        set_tb(i, w, move);
+      }
+      prev_row.swap(cur_row);
+    }
+
+    const int64_t w_final = diff - dmin;
+    const int32_t dist =
+        (w_final >= 0 && w_final < width) ? prev_row[w_final] : kInf;
+
+    // Ukkonen criterion: a distance within the band radius is optimal.
+    if (dist <= k || k >= k_cap) {
+      std::string rev_ops;
+      rev_ops.reserve(q_len + t_len);
+      uint32_t i = q_len;
+      int64_t j = t_len;
+      while (i > 0 || j > 0) {
+        const int64_t w = j - i - dmin;
+        const size_t idx = static_cast<size_t>(i) * width + w;
+        const uint8_t move = (tb[idx >> 2] >> ((idx & 3) << 1)) & 3;
+        if (i > 0 && j > 0 && move == 0) {
+          rev_ops += 'M';
+          --i;
+          --j;
+        } else if (j > 0 && move == 1) {
+          rev_ops += 'D';
+          --j;
+        } else {
+          rev_ops += 'I';
+          --i;
+        }
+      }
+
+      std::string cigar;
+      uint32_t run = 0;
+      char run_op = 0;
+      for (auto it = rev_ops.rbegin(); it != rev_ops.rend(); ++it) {
+        if (*it == run_op) {
+          ++run;
+        } else {
+          push_op(cigar, run_op, run);
+          run_op = *it;
+          run = 1;
+        }
+      }
+      push_op(cigar, run_op, run);
+      return cigar;
+    }
+    k *= 2;
+  }
+}
+
+// Myers/Hyyro bit-parallel global edit distance over 64-row blocks.
+int64_t edit_distance(const char* q, uint32_t q_len, const char* t,
+                      uint32_t t_len) {
+  if (q_len == 0) {
+    return t_len;
+  }
+  if (t_len == 0) {
+    return q_len;
+  }
+
+  const uint32_t W = (q_len + 63) / 64;
+  // Peq[block][symbol]: match mask for the 64 query rows of the block.
+  std::vector<uint64_t> peq(static_cast<size_t>(W) * 256, 0);
+  for (uint32_t i = 0; i < q_len; ++i) {
+    const uint8_t c = static_cast<uint8_t>(q[i]);
+    peq[static_cast<size_t>(i / 64) * 256 + c] |= 1ull << (i % 64);
+  }
+
+  std::vector<uint64_t> vp(W, ~0ull), vn(W, 0);
+  // Score at the bottom row of the last block (virtual rows beyond q_len
+  // never match, which keeps the recurrence exact for row q_len).
+  int64_t score = 64ll * W;
+
+  constexpr uint64_t kHigh = 1ull << 63;
+
+  for (uint32_t j = 0; j < t_len; ++j) {
+    const uint8_t c = static_cast<uint8_t>(t[j]);
+    int hin = 1;  // top boundary D[0][j] = j increments every column
+    for (uint32_t b = 0; b < W; ++b) {
+      uint64_t eq = peq[static_cast<size_t>(b) * 256 + c];
+      const uint64_t pv = vp[b], mv = vn[b];
+      const uint64_t xv = eq | mv;
+      if (hin < 0) {
+        eq |= 1;
+      }
+      const uint64_t xh = (((eq & pv) + pv) ^ pv) | eq;
+      uint64_t ph = mv | ~(xh | pv);
+      uint64_t mh = pv & xh;
+      int hout = 0;
+      if (ph & kHigh) {
+        hout = 1;
+      } else if (mh & kHigh) {
+        hout = -1;
+      }
+      ph <<= 1;
+      mh <<= 1;
+      if (hin < 0) {
+        mh |= 1;
+      } else if (hin > 0) {
+        ph |= 1;
+      }
+      vp[b] = mh | ~(xv | ph);
+      vn[b] = ph & xv;
+      hin = hout;
+    }
+    score += hin;
+  }
+
+  // Peel virtual rows below q_len off the final column.
+  for (int64_t r = 64ll * W - 1; r >= q_len; --r) {
+    const uint32_t b = static_cast<uint32_t>(r / 64);
+    const uint64_t bit = 1ull << (r % 64);
+    if (vp[b] & bit) {
+      --score;
+    } else if (vn[b] & bit) {
+      ++score;
+    }
+  }
+  return score;
+}
+
+}  // namespace rt

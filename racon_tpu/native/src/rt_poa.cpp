@@ -1,0 +1,449 @@
+#include "rt_poa.hpp"
+
+#include <algorithm>
+#include <cassert>
+#include <cmath>
+#include <limits>
+#include <numeric>
+
+namespace rt {
+
+namespace {
+constexpr int32_t kNegInf = std::numeric_limits<int32_t>::min() / 4;
+}
+
+int32_t PoaGraph::new_column(double key) {
+  col_keys_.push_back(key);
+  col_members_.emplace_back();
+  return static_cast<int32_t>(col_keys_.size()) - 1;
+}
+
+int32_t PoaGraph::new_node(char base, int32_t col) {
+  PoaNode n;
+  n.base = base;
+  n.col = col;
+  n.coverage = 0;
+  nodes_.push_back(std::move(n));
+  const int32_t id = static_cast<int32_t>(nodes_.size()) - 1;
+  col_members_[col].push_back(id);
+  return id;
+}
+
+void PoaGraph::add_or_bump_edge(int32_t src, int32_t dst, int64_t w) {
+  for (int32_t e : nodes_[src].out_edges) {
+    if (edges_[e].dst == dst) {
+      edges_[e].weight += w;
+      return;
+    }
+  }
+  PoaEdge e{src, dst, w};
+  edges_.push_back(e);
+  const int32_t id = static_cast<int32_t>(edges_.size()) - 1;
+  nodes_[src].out_edges.push_back(id);
+  nodes_[dst].in_edges.push_back(id);
+}
+
+void PoaGraph::add_alignment(const PoaAlignment& alignment, const char* seq,
+                             uint32_t len,
+                             const std::vector<uint32_t>& weights) {
+  if (len == 0) {
+    return;
+  }
+  ++num_sequences_;
+
+  if (alignment.empty()) {
+    // Fresh source->sink chain (the window backbone). Integer column keys —
+    // backbone column i gets key exactly i, which is what the key-range
+    // subgraph filter relies on.
+    double base_key = -1.0;
+    for (double k : col_keys_) {
+      base_key = std::max(base_key, k);
+    }
+    base_key = std::floor(base_key) + 1.0;
+    int32_t prev = -1;
+    for (uint32_t p = 0; p < len; ++p) {
+      const int32_t node = new_node(seq[p], new_column(base_key + p));
+      ++nodes_[node].coverage;
+      if (prev != -1) {
+        add_or_bump_edge(prev, node,
+                         static_cast<int64_t>(weights[p - 1]) +
+                             static_cast<int64_t>(weights[p]));
+      }
+      prev = node;
+    }
+    return;
+  }
+
+  // Seq position -> matched graph node (-1 = insertion, gets a new column).
+  std::vector<int32_t> pos_node(len, -1);
+  for (const auto& pr : alignment) {
+    if (pr.second != -1 && pr.first != -1) {
+      pos_node[pr.second] = pr.first;
+    }
+  }
+
+  int32_t prev = -1;
+  int32_t prev_pos = -1;
+  uint32_t pos = 0;
+  while (pos < len) {
+    const char b = seq[pos];
+    int32_t node;
+    if (pos_node[pos] != -1) {
+      const int32_t n = pos_node[pos];
+      const int32_t col = nodes_[n].col;
+      if (nodes_[n].base == b) {
+        node = n;
+      } else {
+        node = -1;
+        for (int32_t m : col_members_[col]) {
+          if (nodes_[m].base == b) {
+            node = m;
+            break;
+          }
+        }
+        if (node == -1) {
+          node = new_node(b, col);  // column sibling == classic aligned ring
+        }
+      }
+      ++pos;
+    } else {
+      // Insertion run [pos, run_end): fresh columns with keys strictly
+      // between the previous path column and the next matched column.
+      // `run_len` is the REMAINING run length (runs shrink as positions are
+      // consumed one per loop iteration), so each new key subdivides the
+      // residual interval and the run stays strictly increasing.
+      uint32_t run_end = pos;
+      while (run_end < len && pos_node[run_end] == -1) {
+        ++run_end;
+      }
+      const uint32_t run_len = run_end - pos;
+      double hi;
+      if (run_end < len) {
+        hi = col_keys_[nodes_[pos_node[run_end]].col];
+      } else if (prev != -1) {
+        hi = col_keys_[nodes_[prev].col] + 1.0;
+      } else {
+        double max_key = -1.0;
+        for (double k : col_keys_) {
+          max_key = std::max(max_key, k);
+        }
+        hi = max_key + static_cast<double>(run_len) + 1.0;
+      }
+      const double lo =
+          prev != -1 ? col_keys_[nodes_[prev].col] : hi - run_len - 1.0;
+
+      const double key = lo + (hi - lo) / (run_len + 1.0);
+      node = new_node(b, new_column(key));
+      ++pos;
+    }
+
+    ++nodes_[node].coverage;
+    if (prev != -1) {
+      add_or_bump_edge(prev, node,
+                       static_cast<int64_t>(weights[prev_pos]) +
+                           static_cast<int64_t>(weights[pos - 1]));
+    }
+    prev = node;
+    prev_pos = static_cast<int32_t>(pos) - 1;
+  }
+}
+
+std::vector<int32_t> PoaGraph::topo_order() const {
+  std::vector<int32_t> order(nodes_.size());
+  std::iota(order.begin(), order.end(), 0);
+  std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+    const double ka = col_keys_[nodes_[a].col], kb = col_keys_[nodes_[b].col];
+    if (ka != kb) {
+      return ka < kb;
+    }
+    return a < b;
+  });
+  return order;
+}
+
+std::string PoaGraph::generate_consensus(
+    std::vector<uint32_t>* coverages) const {
+  std::string consensus;
+  if (nodes_.empty()) {
+    if (coverages) {
+      coverages->clear();
+    }
+    return consensus;
+  }
+
+  const auto order = topo_order();
+  std::vector<int64_t> score(nodes_.size(), 0);
+  std::vector<int32_t> pred(nodes_.size(), -1);
+
+  // Heaviest bundle: each node takes its best in-edge by
+  // (edge weight, predecessor score).
+  int32_t best_node = order[0];
+  for (int32_t u : order) {
+    int64_t best_w = -1, best_pred_score = -1;
+    int32_t best_pred = -1;
+    for (int32_t e : nodes_[u].in_edges) {
+      const int64_t w = edges_[e].weight;
+      const int64_t s = score[edges_[e].src];
+      if (w > best_w || (w == best_w && s > best_pred_score)) {
+        best_w = w;
+        best_pred_score = s;
+        best_pred = edges_[e].src;
+      }
+    }
+    if (best_pred != -1) {
+      score[u] = best_w + score[best_pred];
+      pred[u] = best_pred;
+    }
+    if (score[u] > score[best_node]) {
+      best_node = u;
+    }
+  }
+
+  // Backward to a source along chosen predecessors, then forward from the
+  // summit to a sink along the heaviest out-edges (branch completion
+  // analogue: the consensus always spans source -> sink, so zero-weight
+  // backbone-only stretches at window edges are retained for the trim stage
+  // to judge; reference behavior: src/window.cpp:122-146).
+  std::vector<int32_t> path;
+  for (int32_t u = best_node; u != -1; u = pred[u]) {
+    path.push_back(u);
+  }
+  std::reverse(path.begin(), path.end());
+
+  int32_t u = best_node;
+  while (!nodes_[u].out_edges.empty()) {
+    int64_t best_w = -1, best_dst_score = -1;
+    int32_t best_dst = -1;
+    for (int32_t e : nodes_[u].out_edges) {
+      const int64_t w = edges_[e].weight;
+      const int64_t s = score[edges_[e].dst];
+      if (w > best_w || (w == best_w && s > best_dst_score)) {
+        best_w = w;
+        best_dst_score = s;
+        best_dst = edges_[e].dst;
+      }
+    }
+    u = best_dst;
+    path.push_back(u);
+  }
+
+  consensus.reserve(path.size());
+  if (coverages) {
+    coverages->clear();
+    coverages->reserve(path.size());
+  }
+  for (int32_t v : path) {
+    consensus += nodes_[v].base;
+    if (coverages) {
+      uint32_t cov = 0;
+      for (int32_t m : col_members_[nodes_[v].col]) {
+        cov += nodes_[m].coverage;
+      }
+      coverages->push_back(cov);
+    }
+  }
+  return consensus;
+}
+
+PoaAlignment PoaAligner::align(const char* seq, uint32_t len,
+                               const PoaGraph& graph, double key_lo,
+                               double key_hi) {
+  PoaAlignment result;
+  if (len == 0 || graph.num_nodes() == 0) {
+    return result;
+  }
+
+  // Subgraph: nodes whose column key lies in [key_lo, key_hi], topo order.
+  sub_.clear();
+  for (uint32_t i = 0; i < graph.num_nodes(); ++i) {
+    const double k = graph.col_key(graph.nodes()[i].col);
+    if (k >= key_lo && k <= key_hi) {
+      sub_.push_back(static_cast<int32_t>(i));
+    }
+  }
+  if (sub_.empty()) {
+    return result;
+  }
+  std::sort(sub_.begin(), sub_.end(), [&](int32_t a, int32_t b) {
+    const double ka = graph.col_key(graph.nodes()[a].col);
+    const double kb = graph.col_key(graph.nodes()[b].col);
+    if (ka != kb) {
+      return ka < kb;
+    }
+    return a < b;
+  });
+
+  const uint32_t S = static_cast<uint32_t>(sub_.size());
+  rank_of_.assign(graph.num_nodes(), 0);
+  for (uint32_t r = 0; r < S; ++r) {
+    rank_of_[sub_[r]] = static_cast<int32_t>(r) + 1;
+  }
+
+  // Predecessor ranks per subgraph node (edges from outside the key range
+  // are cut, turning their targets into subgraph sources).
+  std::vector<std::vector<int32_t>> preds(S);
+  for (uint32_t r = 0; r < S; ++r) {
+    for (int32_t e : graph.nodes()[sub_[r]].in_edges) {
+      const int32_t pr = rank_of_[graph.edges()[e].src];
+      if (pr > 0) {
+        preds[r].push_back(pr);
+      }
+    }
+  }
+
+  const uint32_t L = len;
+  const size_t stride = L + 1;
+  h_.assign(static_cast<size_t>(S + 1) * stride, kNegInf);
+
+  // Virtual start row.
+  for (uint32_t j = 0; j <= L; ++j) {
+    h_[j] = static_cast<int32_t>(j) * gap_;
+  }
+
+  for (uint32_t r = 1; r <= S; ++r) {
+    const int32_t u = sub_[r - 1];
+    const char ub = graph.nodes()[u].base;
+    int32_t* row = h_.data() + static_cast<size_t>(r) * stride;
+    const auto& pr = preds[r - 1];
+
+    if (pr.empty()) {
+      // Single virtual predecessor (row 0).
+      const int32_t* prow = h_.data();
+      row[0] = prow[0] + gap_;
+      for (uint32_t j = 1; j <= L; ++j) {
+        const int32_t diag =
+            prow[j - 1] + (seq[j - 1] == ub ? match_ : mismatch_);
+        const int32_t up = prow[j] + gap_;
+        int32_t best = diag > up ? diag : up;
+        const int32_t left = row[j - 1] + gap_;
+        if (left > best) {
+          best = left;
+        }
+        row[j] = best;
+      }
+    } else {
+      // First predecessor initializes, the rest max-merge.
+      {
+        const int32_t* prow = h_.data() + static_cast<size_t>(pr[0]) * stride;
+        row[0] = prow[0] + gap_;
+        for (uint32_t j = 1; j <= L; ++j) {
+          const int32_t diag =
+              prow[j - 1] + (seq[j - 1] == ub ? match_ : mismatch_);
+          const int32_t up = prow[j] + gap_;
+          row[j] = diag > up ? diag : up;
+        }
+      }
+      for (size_t pi = 1; pi < pr.size(); ++pi) {
+        const int32_t* prow =
+            h_.data() + static_cast<size_t>(pr[pi]) * stride;
+        if (prow[0] + gap_ > row[0]) {
+          row[0] = prow[0] + gap_;
+        }
+        for (uint32_t j = 1; j <= L; ++j) {
+          const int32_t diag =
+              prow[j - 1] + (seq[j - 1] == ub ? match_ : mismatch_);
+          const int32_t up = prow[j] + gap_;
+          const int32_t cand = diag > up ? diag : up;
+          if (cand > row[j]) {
+            row[j] = cand;
+          }
+        }
+      }
+      // Horizontal pass.
+      for (uint32_t j = 1; j <= L; ++j) {
+        const int32_t left = row[j - 1] + gap_;
+        if (left > row[j]) {
+          row[j] = left;
+        }
+      }
+    }
+  }
+
+  // Out-degree within the subgraph decides the end-node set.
+  std::vector<uint8_t> has_out(S, 0);
+  for (uint32_t r = 0; r < S; ++r) {
+    for (int32_t e : graph.nodes()[sub_[r]].out_edges) {
+      if (rank_of_[graph.edges()[e].dst] > 0) {
+        has_out[r] = 1;
+        break;
+      }
+    }
+  }
+  int32_t best_rank = -1;
+  int32_t best_score = kNegInf;
+  for (uint32_t r = 1; r <= S; ++r) {
+    if (!has_out[r - 1]) {
+      const int32_t s = h_[static_cast<size_t>(r) * stride + L];
+      if (s > best_score) {
+        best_score = s;
+        best_rank = static_cast<int32_t>(r);
+      }
+    }
+  }
+
+  // Traceback by transition re-checking (H holds exact maxima, so any
+  // satisfying transition lies on an optimal path). Priority: diag, up, left.
+  int32_t r = best_rank;
+  uint32_t j = L;
+  PoaAlignment rev;
+  while (r != 0 || j != 0) {
+    if (r == 0) {
+      rev.emplace_back(-1, static_cast<int32_t>(j) - 1);
+      --j;
+      continue;
+    }
+    const int32_t u = sub_[r - 1];
+    const char ub = graph.nodes()[u].base;
+    const int32_t* row = h_.data() + static_cast<size_t>(r) * stride;
+    const auto& pr = preds[r - 1];
+    const int32_t cur = row[j];
+    bool moved = false;
+
+    const int32_t s = j > 0 ? (seq[j - 1] == ub ? match_ : mismatch_) : 0;
+    if (pr.empty()) {
+      const int32_t* prow = h_.data();
+      if (j > 0 && prow[j - 1] + s == cur) {
+        rev.emplace_back(u, static_cast<int32_t>(j) - 1);
+        r = 0;
+        --j;
+        moved = true;
+      } else if (prow[j] + gap_ == cur) {
+        rev.emplace_back(u, -1);
+        r = 0;
+        moved = true;
+      }
+    } else {
+      for (int32_t p : pr) {
+        const int32_t* prow = h_.data() + static_cast<size_t>(p) * stride;
+        if (j > 0 && prow[j - 1] + s == cur) {
+          rev.emplace_back(u, static_cast<int32_t>(j) - 1);
+          r = p;
+          --j;
+          moved = true;
+          break;
+        }
+      }
+      if (!moved) {
+        for (int32_t p : pr) {
+          const int32_t* prow = h_.data() + static_cast<size_t>(p) * stride;
+          if (prow[j] + gap_ == cur) {
+            rev.emplace_back(u, -1);
+            r = p;
+            moved = true;
+            break;
+          }
+        }
+      }
+    }
+    if (!moved) {
+      // Left move (insertion).
+      rev.emplace_back(-1, static_cast<int32_t>(j) - 1);
+      --j;
+    }
+  }
+
+  result.assign(rev.rbegin(), rev.rend());
+  return result;
+}
+
+}  // namespace rt

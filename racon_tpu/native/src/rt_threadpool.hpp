@@ -1,0 +1,90 @@
+// Minimal work-stealing-free thread pool with futures and a per-thread index
+// map (so each worker can own a reusable POA aligner, the way the reference
+// gives each thread its own spoa engine — /root/reference/src/polisher.cpp:
+// 176,179-183,497-503). New implementation, parity with the vendored
+// thread_pool library's Submit/thread_map surface.
+#pragma once
+
+#include <condition_variable>
+#include <functional>
+#include <future>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace rt {
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(uint32_t num_threads) {
+    num_threads = num_threads == 0 ? 1 : num_threads;
+    for (uint32_t i = 0; i < num_threads; ++i) {
+      workers_.emplace_back([this] { loop(); });
+    }
+    for (uint32_t i = 0; i < num_threads; ++i) {
+      thread_map_[workers_[i].get_id()] = i;
+    }
+  }
+
+  ~ThreadPool() {
+    {
+      std::unique_lock<std::mutex> lock(mutex_);
+      done_ = true;
+    }
+    cv_.notify_all();
+    for (auto& w : workers_) {
+      w.join();
+    }
+  }
+
+  template <typename F>
+  std::future<void> submit(F&& f) {
+    auto task = std::make_shared<std::packaged_task<void()>>(std::forward<F>(f));
+    auto fut = task->get_future();
+    {
+      std::unique_lock<std::mutex> lock(mutex_);
+      queue_.emplace([task] { (*task)(); });
+    }
+    cv_.notify_one();
+    return fut;
+  }
+
+  uint32_t num_threads() const { return static_cast<uint32_t>(workers_.size()); }
+
+  // Index of the calling thread: workers get 0..n-1; any non-pool caller
+  // (e.g. the Python driver finishing device-rejected work) gets the
+  // dedicated slot n, so its scratch state never races a worker's.
+  uint32_t this_thread_index() const {
+    auto it = thread_map_.find(std::this_thread::get_id());
+    return it == thread_map_.end() ? static_cast<uint32_t>(workers_.size())
+                                   : it->second;
+  }
+
+ private:
+  void loop() {
+    while (true) {
+      std::function<void()> job;
+      {
+        std::unique_lock<std::mutex> lock(mutex_);
+        cv_.wait(lock, [this] { return done_ || !queue_.empty(); });
+        if (done_ && queue_.empty()) {
+          return;
+        }
+        job = std::move(queue_.front());
+        queue_.pop();
+      }
+      job();
+    }
+  }
+
+  std::vector<std::thread> workers_;
+  std::unordered_map<std::thread::id, uint32_t> thread_map_;
+  std::queue<std::function<void()>> queue_;
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  bool done_ = false;
+};
+
+}  // namespace rt

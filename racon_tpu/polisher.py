@@ -1,0 +1,74 @@
+"""Polisher front-ends: CPU oracle path and the TPU-backed path.
+
+Mirrors the reference's factory seam (racon::createPolisher returning either
+the base Polisher or the CUDA subclass, /root/reference/src/polisher.cpp:
+137-163): `create_polisher(..., backend=...)` returns a polisher whose two hot
+phases run either on the host oracle or on the TPU batch kernels with host
+fallback for rejected work (the reference's graceful-degradation lattice,
+src/cuda/cudapolisher.cpp:204-213,354-378).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from .pipeline import Pipeline
+
+
+class CpuPolisher:
+    """Pure-host polishing (the correctness oracle)."""
+
+    def __init__(self, sequences_path: str, overlaps_path: str,
+                 target_path: str, **kwargs):
+        self._pipeline = Pipeline(sequences_path, overlaps_path, target_path,
+                                  **kwargs)
+
+    def initialize(self) -> None:
+        self._pipeline.initialize()
+
+    def polish(self, drop_unpolished: bool = True) -> List[Tuple[str, str]]:
+        self._pipeline.consensus_cpu_all()
+        return self._pipeline.stitch(drop_unpolished)
+
+
+class TpuPolisher:
+    """TPU-backed polishing: batched banded alignment + batched POA on
+    device, host fallback for work outside device limits."""
+
+    def __init__(self, sequences_path: str, overlaps_path: str,
+                 target_path: str, **kwargs):
+        self._kwargs = dict(kwargs)
+        self._pipeline = Pipeline(sequences_path, overlaps_path, target_path,
+                                  **kwargs)
+
+    def initialize(self) -> None:
+        try:
+            from .ops.align_driver import run_alignment_phase
+        except ImportError as e:
+            raise RuntimeError(
+                "TPU backend unavailable (racon_tpu.ops failed to import); "
+                "run without --tpu for the host path") from e
+
+        self._pipeline.prepare()
+        run_alignment_phase(self._pipeline)   # device + host fallback
+        self._pipeline.build_windows()
+
+    def polish(self, drop_unpolished: bool = True) -> List[Tuple[str, str]]:
+        from .ops.poa_driver import run_consensus_phase
+
+        run_consensus_phase(self._pipeline,
+                            match=self._kwargs.get("match", 3),
+                            mismatch=self._kwargs.get("mismatch", -5),
+                            gap=self._kwargs.get("gap", -4),
+                            trim=self._kwargs.get("trim", True))
+        return self._pipeline.stitch(drop_unpolished)
+
+
+def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
+                    backend: str = "cpu", **kwargs):
+    """Factory. backend: 'cpu' (host oracle) or 'tpu' (device batched)."""
+    if backend == "cpu":
+        return CpuPolisher(sequences_path, overlaps_path, target_path, **kwargs)
+    if backend == "tpu":
+        return TpuPolisher(sequences_path, overlaps_path, target_path, **kwargs)
+    raise ValueError(f"unknown backend: {backend!r}")

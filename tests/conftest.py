@@ -1,0 +1,54 @@
+"""Test configuration.
+
+JAX runs on a virtual 8-device CPU mesh so multi-chip sharding compiles and
+executes in CI without TPU hardware (the driver separately dry-runs the
+multi-chip path; see __graft_entry__.py). Must be set before jax imports.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import gzip  # noqa: E402
+
+import pytest  # noqa: E402
+
+DATA = "/root/reference/test/data/"
+
+_COMP = bytes.maketrans(b"ACGT", b"TGCA")
+
+
+def revcomp(s: bytes) -> bytes:
+    return s.translate(_COMP)[::-1]
+
+
+def read_fasta_gz(path):
+    out = []
+    name, chunks = None, []
+    with gzip.open(path, "rt") as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith(">"):
+                if name is not None:
+                    out.append((name, "".join(chunks)))
+                name = line[1:].split()[0]
+                chunks = []
+            else:
+                chunks.append(line)
+    if name is not None:
+        out.append((name, "".join(chunks)))
+    return out
+
+
+@pytest.fixture(scope="session")
+def lambda_reference() -> bytes:
+    recs = read_fasta_gz(DATA + "sample_reference.fasta.gz")
+    assert len(recs) == 1
+    return recs[0][1].encode()

@@ -1,0 +1,117 @@
+"""Host aligner unit tests: Myers edit distance and banded NW CIGAR against a
+naive O(nm) reference DP."""
+
+import random
+
+import pytest
+
+from racon_tpu import native
+
+
+def naive_edit_distance(q: bytes, t: bytes) -> int:
+    n, m = len(q), len(t)
+    prev = list(range(m + 1))
+    for i in range(1, n + 1):
+        cur = [i] + [0] * m
+        for j in range(1, m + 1):
+            cur[j] = min(prev[j - 1] + (q[i - 1] != t[j - 1]),
+                         prev[j] + 1, cur[j - 1] + 1)
+        prev = cur
+    return prev[m]
+
+
+def mutate(seq: bytes, rate: float, rng: random.Random) -> bytes:
+    out = bytearray()
+    bases = b"ACGT"
+    for c in seq:
+        r = rng.random()
+        if r < rate / 3:
+            out.append(rng.choice(bases))  # substitution
+        elif r < 2 * rate / 3:
+            pass  # deletion
+        elif r < rate:
+            out.append(c)
+            out.append(rng.choice(bases))  # insertion
+        else:
+            out.append(c)
+    return bytes(out)
+
+
+def cigar_consumed(cigar: str):
+    q = t = 0
+    num = ""
+    for ch in cigar:
+        if ch.isdigit():
+            num += ch
+        else:
+            n = int(num)
+            num = ""
+            if ch in "MI":
+                q += n
+            if ch in "MD":
+                t += n
+    return q, t
+
+
+def cigar_cost_upper_bound(cigar: str, q: bytes, t: bytes) -> int:
+    """Edit cost of the alignment path described by the CIGAR."""
+    cost = 0
+    qi = ti = 0
+    num = ""
+    for ch in cigar:
+        if ch.isdigit():
+            num += ch
+            continue
+        n = int(num)
+        num = ""
+        if ch == "M":
+            for _ in range(n):
+                cost += q[qi] != t[ti]
+                qi += 1
+                ti += 1
+        elif ch == "I":
+            cost += n
+            qi += n
+        elif ch == "D":
+            cost += n
+            ti += n
+    return cost
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("rate", [0.05, 0.2, 0.4])
+def test_edit_distance_matches_naive(seed, rate):
+    rng = random.Random(seed)
+    a = bytes(rng.choice(b"ACGT") for _ in range(rng.randint(50, 300)))
+    b = mutate(a, rate, rng)
+    assert native.edit_distance(a, b) == naive_edit_distance(a, b)
+
+
+def test_edit_distance_long_multiblock():
+    rng = random.Random(7)
+    a = bytes(rng.choice(b"ACGT") for _ in range(1000))
+    b = mutate(a, 0.15, rng)
+    assert native.edit_distance(a, b) == naive_edit_distance(a, b)
+
+
+def test_edit_distance_trivial():
+    assert native.edit_distance(b"", b"ACGT") == 4
+    assert native.edit_distance(b"ACGT", b"") == 4
+    assert native.edit_distance(b"ACGT", b"ACGT") == 0
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_cigar_is_optimal_path(seed):
+    rng = random.Random(seed)
+    a = bytes(rng.choice(b"ACGT") for _ in range(rng.randint(100, 500)))
+    b = mutate(a, 0.2, rng)
+    cigar = native.align_cigar(a, b)
+    qc, tc = cigar_consumed(cigar)
+    assert qc == len(a) and tc == len(b)
+    # The path's cost must equal the optimal edit distance.
+    assert cigar_cost_upper_bound(cigar, a, b) == naive_edit_distance(a, b)
+
+
+def test_cigar_empty_inputs():
+    assert native.align_cigar(b"", b"AC") == "2D"
+    assert native.align_cigar(b"AC", b"") == "2I"

@@ -1,0 +1,83 @@
+"""Host POA engine tests: consensus recovery on synthetic windows.
+
+Reference behavior model: /root/reference/src/window.cpp:65-149 (POA over a
+backbone plus layers, quality weighting, TGS trim)."""
+
+import random
+
+from racon_tpu import native
+
+
+def mutate(seq: bytes, rate: float, rng: random.Random) -> bytes:
+    out = bytearray()
+    bases = b"ACGT"
+    for c in seq:
+        r = rng.random()
+        if r < rate / 3:
+            out.append(rng.choice(bases))
+        elif r < 2 * rate / 3:
+            pass
+        elif r < rate:
+            out.append(c)
+            out.append(rng.choice(bases))
+        else:
+            out.append(c)
+    return bytes(out)
+
+
+def test_fewer_than_two_layers_returns_backbone():
+    bb = b"ACGTACGTACGT"
+    cons, polished = native.window_consensus(bb, [b"ACGTACGTACGT"])
+    assert cons == bb
+    assert polished is False
+
+
+def test_identical_layers_reproduce_truth():
+    rng = random.Random(3)
+    truth = bytes(rng.choice(b"ACGT") for _ in range(200))
+    layers = [truth] * 5
+    cons, polished = native.window_consensus(truth, layers, trim=False)
+    assert polished is True
+    assert cons == truth
+
+
+def test_noisy_layers_recover_truth():
+    rng = random.Random(11)
+    truth = bytes(rng.choice(b"ACGT") for _ in range(500))
+    backbone = mutate(truth, 0.10, rng)
+    layers = [mutate(truth, 0.10, rng) for _ in range(20)]
+    cons, polished = native.window_consensus(backbone, layers, trim=False)
+    assert polished is True
+    # POA consensus over 20 noisy copies should be far closer to the truth
+    # than any single 10%-error layer.
+    d = native.edit_distance(cons, truth)
+    assert d < 0.02 * len(truth), d
+
+
+def test_quality_weighting_prefers_confident_bases():
+    # Two variants at one site; the minority variant carries much higher
+    # quality, so weighted consensus should pick it.
+    truth_a = b"ACGTACGTGGACGTACGTAA" * 5
+    truth_c = truth_a.replace(b"GG", b"CC")
+    layers = [truth_a, truth_a, truth_c, truth_c, truth_c]
+    quals = [bytes([33 + 1] * len(truth_a))] * 2 + \
+        [bytes([33 + 40] * len(truth_c))] * 3
+    cons, _ = native.window_consensus(truth_a, layers, quals=quals, trim=False)
+    assert b"CC" in cons
+
+
+def test_tgs_trim_cuts_uncovered_ends():
+    rng = random.Random(5)
+    truth = bytes(rng.choice(b"ACGT") for _ in range(300))
+    # Layers only cover the middle 200 bases.
+    mid = truth[50:250]
+    layers = [mutate(mid, 0.05, rng) for _ in range(10)]
+    begins = [50] * len(layers)
+    ends = [249] * len(layers)
+    cons_trim, _ = native.window_consensus(
+        truth, layers, begins=begins, ends=ends, tgs=True, trim=True)
+    cons_notrim, _ = native.window_consensus(
+        truth, layers, begins=begins, ends=ends, tgs=True, trim=False)
+    assert len(cons_trim) < len(cons_notrim)
+    assert len(cons_trim) <= 220
+    assert native.edit_distance(cons_trim, mid) < 0.05 * len(mid)
