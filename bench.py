@@ -1,0 +1,59 @@
+"""Benchmark: polished Mbp/sec on the device path vs the host oracle path.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Dataset: the lambda-phage polishing workload (reads FASTQ + PAF overlaps +
+draft layout, window=500, wrapper scores m=5 x=-4 g=-8 — the reference test
+suite's standard scenario, /root/reference/test/racon_test.cpp:86-107).
+value = polished megabases per second of end-to-end wall time (parse ->
+polished FASTA) on the accelerated path; vs_baseline = speedup over the
+host CPU path measured on the same machine (the reference's own comparison
+axis: accelerated backend vs its CPU SPOA path).
+"""
+
+import json
+import sys
+import time
+
+D = "/root/reference/test/data/"
+ARGS = dict(window_length=500, quality_threshold=10.0, error_threshold=0.3,
+            match=5, mismatch=-4, gap=-8, num_threads=1)
+
+
+def run(backend: str):
+    import racon_tpu
+
+    t0 = time.time()
+    p = racon_tpu.create_polisher(
+        D + "sample_reads.fastq.gz", D + "sample_overlaps.paf.gz",
+        D + "sample_layout.fasta.gz", backend=backend, **ARGS)
+    p.initialize()
+    res = p.polish(True)
+    dt = time.time() - t0
+    polished_bp = sum(len(d) for _, d in res)
+    return polished_bp, dt
+
+
+def main():
+    # Warm the device path once so compile time is not billed as throughput
+    # (compiled kernels are cached for the steady-state measurement).
+    run("tpu")
+    bp_tpu, dt_tpu = run("tpu")
+    bp_cpu, dt_cpu = run("cpu")
+
+    mbps_tpu = bp_tpu / dt_tpu / 1e6
+    mbps_cpu = bp_cpu / dt_cpu / 1e6
+    print(json.dumps({
+        "metric": "polished Mbp/sec (lambda 47.5kb, PAF+qual, w=500, "
+                  "end-to-end)",
+        "value": round(mbps_tpu, 4),
+        "unit": "Mbp/s",
+        "vs_baseline": round(mbps_tpu / mbps_cpu, 3),
+    }))
+    print(f"[bench] tpu: {bp_tpu} bp in {dt_tpu:.1f}s | "
+          f"cpu: {bp_cpu} bp in {dt_cpu:.1f}s", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

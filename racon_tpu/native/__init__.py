@@ -35,11 +35,11 @@ def _newer_than_lib(path: str) -> bool:
 def ensure_built() -> str:
     """Build libracon_host.so if missing or stale. Returns its path."""
     src_dir = os.path.join(_DIR, "src")
+    inputs = [os.path.join(src_dir, f) for f in os.listdir(src_dir)
+              if f.endswith((".cpp", ".hpp"))]
+    inputs.append(os.path.join(_DIR, "Makefile"))
     stale = not os.path.exists(_LIB_PATH) or any(
-        _newer_than_lib(os.path.join(src_dir, f))
-        for f in os.listdir(src_dir)
-        if f.endswith((".cpp", ".hpp"))
-    )
+        _newer_than_lib(p) for p in inputs)
     if stale:
         proc = subprocess.run(
             ["make", "-j", str(os.cpu_count() or 4)],

@@ -1,7 +1,9 @@
 // C ABI for the racon-tpu native runtime, consumed by the Python driver via
 // ctypes (no pybind11 dependency). Handles own all memory; strings returned
 // to Python live inside the handle or in rt_free()-able buffers.
+#include <algorithm>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <vector>

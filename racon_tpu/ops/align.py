@@ -1,0 +1,178 @@
+"""Batched banded global (NW) alignment on device.
+
+TPU-native replacement for the reference's edlib call on CIGAR-less overlaps
+(/root/reference/src/overlap.cpp:205-224) and its CUDA batch analogue
+(/root/reference/src/cuda/cudaaligner.cpp). Unit costs, static band per
+size bucket (the reference GPU path also aligns banded: auto band = 10% of
+mean overlap length, src/cuda/cudapolisher.cpp:159-163).
+
+Formulation: rows i over the query, each row a K-lane vector over band
+offsets o, with cell (i, o) <-> target column j = i + dmin + o. The
+horizontal (target-gap) dependency is resolved with the affine-transform
+cummin: D[i][o] = o + cummin(V[i][o] - o). A 2-bit move per cell (stored as
+u8) supports an exact in-band traceback; ops are RLE'd to a CIGAR on host.
+
+In-band paths are valid alignments but may be suboptimal if the true path
+leaves the band — same approximation contract as the reference's banded CUDA
+aligner, with accuracy pinned by the golden tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .encoding import encode
+
+INF = jnp.int32(1 << 28)
+
+# (max sequence length, band width) buckets; larger pairs go to the host.
+BUCKETS = ((1024, 256), (2048, 512), (4096, 1024), (8192, 2048))
+MAX_DEVICE_LEN = BUCKETS[-1][0]
+
+
+def device_eligible(q_len: int, t_len: int) -> bool:
+    n, m = int(q_len), int(t_len)
+    if n == 0 or m == 0:
+        return False
+    size = max(n, m)
+    for cap, band in BUCKETS:
+        if size <= cap:
+            return abs(m - n) + 2 <= band
+    return False
+
+
+def _bucket_for(size: int):
+    for cap, band in BUCKETS:
+        if size <= cap:
+            return cap, band
+    raise ValueError(size)
+
+
+@functools.lru_cache(maxsize=16)
+def build_align_kernel(cap: int, band: int):
+    """jit kernel over a batch: returns (moves-free) ops + lengths."""
+    K = band
+    PAD = K + 2
+
+    def one(q, t, n, m):
+        # q, t: u8 codes padded to cap; n, m actual lengths.
+        diff = m - n
+        slack = (K - 1 - jnp.abs(diff)) // 2
+        dmin = jnp.minimum(0, diff) - slack
+
+        t_pad = jnp.full(cap + 2 * PAD, 255, dtype=jnp.uint8)
+        t_pad = jax.lax.dynamic_update_slice(t_pad, t, (PAD,))
+
+        o_vec = jnp.arange(K, dtype=jnp.int32)
+
+        row0_j = dmin + o_vec
+        row0 = jnp.where((row0_j >= 0) & (row0_j <= m), row0_j, INF)
+
+        def row_fn(prev_row, xs):
+            qc, i = xs  # i = 1..cap
+            j_vec = i + dmin + o_vec
+            tsl = jax.lax.dynamic_slice(t_pad, (i + dmin - 1 + PAD,), (K,))
+            sub = prev_row + jnp.where(tsl == qc, 0, 1)
+            up = jnp.concatenate([prev_row[1:], jnp.array([INF])]) + 1
+            V = jnp.minimum(sub, up)
+            mv = jnp.where(V == sub, jnp.uint8(0), jnp.uint8(1))
+            # boundary column j == 0: only vertical moves
+            V = jnp.where(j_vec == 0, i, V)
+            mv = jnp.where(j_vec == 0, jnp.uint8(1), mv)
+            V = jnp.where((j_vec < 0) | (j_vec > m), INF, V)
+            # horizontal pass
+            row = jax.lax.cummin(V - o_vec) + o_vec
+            mv = jnp.where(row < V, jnp.uint8(2), mv)
+            row = jnp.where((j_vec < 0) | (j_vec > m), INF, row)
+            return row, mv
+
+        ii = jnp.arange(1, cap + 1, dtype=jnp.int32)
+        _, moves = jax.lax.scan(row_fn, row0, (q.astype(jnp.uint8), ii))
+        # moves[i-1] is row i
+
+        # Traceback from (n, j=m).
+        OPS = 2 * cap
+
+        def cond(c):
+            i, j, _, cnt, _ = c
+            return ((i > 0) | (j > 0)) & (cnt < OPS)
+
+        def body(c):
+            i, j, ops, cnt, ok = c
+            o = j - i - dmin
+            in_band = (o >= 0) & (o < K)
+            mv = jnp.where(i > 0,
+                           jnp.where(in_band,
+                                     moves[jnp.maximum(i - 1, 0),
+                                           jnp.clip(o, 0, K - 1)],
+                                     jnp.uint8(3)),
+                           jnp.uint8(2))  # row 0: consume target
+            ok = ok & (mv != 3)
+            # 0=M (diag), 1=I (query), 2=D (target)
+            ops = ops.at[cnt].set(mv)
+            i = jnp.where(mv == 2, i, i - 1)
+            j = jnp.where(mv == 1, j, j - 1)
+            return (i, j, ops, cnt + 1, ok)
+
+        ops0 = jnp.zeros(OPS, dtype=jnp.uint8)
+        i, j, ops, cnt, ok = jax.lax.while_loop(
+            cond, body, (n, m, ops0, jnp.int32(0), jnp.bool_(True)))
+        ok = ok & (i == 0) & (j == 0)
+        return ops, cnt, ok
+
+    return jax.jit(jax.vmap(one))
+
+
+def run_jobs(pipeline, jobs, batch: int = 16) -> int:
+    """Align the given pipeline jobs on device; install CIGARs.
+    Returns how many alignments the device served."""
+    served = 0
+    # Group by bucket.
+    grouped = {}
+    for job in jobs:
+        qa, ta = pipeline.align_job(job)
+        cap, band = _bucket_for(max(len(qa), len(ta)))
+        grouped.setdefault((cap, band), []).append((job, qa, ta))
+
+    for (cap, band), items in sorted(grouped.items()):
+        kernel = build_align_kernel(cap, band)
+        for off in range(0, len(items), batch):
+            chunk = items[off:off + batch]
+            B = len(chunk)
+            q = np.zeros((B, cap), dtype=np.uint8)
+            t = np.zeros((B, cap), dtype=np.uint8)
+            n = np.zeros(B, dtype=np.int32)
+            m = np.zeros(B, dtype=np.int32)
+            for bi, (job, qa, ta) in enumerate(chunk):
+                q[bi, :len(qa)] = encode(qa)
+                t[bi, :len(ta)] = encode(ta)
+                n[bi] = len(qa)
+                m[bi] = len(ta)
+            ops, cnt, ok = (np.asarray(x) for x in kernel(q, t, n, m))
+            for bi, (job, qa, ta) in enumerate(chunk):
+                if not ok[bi]:
+                    continue  # host will align it
+                cigar = ops_to_cigar(ops[bi, :cnt[bi]][::-1])
+                pipeline.set_job_cigar(job, cigar)
+                served += 1
+    return served
+
+
+_OPC = np.frombuffer(b"MID", dtype=np.uint8)
+
+
+def ops_to_cigar(ops: np.ndarray) -> str:
+    """Run-length encode forward-ordered op codes (0=M,1=I,2=D)."""
+    if len(ops) == 0:
+        return ""
+    change = np.nonzero(np.diff(ops))[0]
+    starts = np.concatenate([[0], change + 1])
+    ends = np.concatenate([change + 1, [len(ops)]])
+    out = []
+    for s, e in zip(starts, ends):
+        out.append(f"{e - s}{chr(_OPC[ops[s]])}")
+    return "".join(out)

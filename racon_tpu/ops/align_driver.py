@@ -1,0 +1,34 @@
+"""Alignment-phase driver: batches CIGAR-less overlaps onto the device
+banded global aligner, installs CIGARs, and lets the host finish whatever the
+device rejects (too long / too divergent), mirroring the reference's
+cudaaligner orchestration (/root/reference/src/cuda/cudapolisher.cpp:74-214,
+rejection statuses src/cuda/cudaaligner.cpp:63-71).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def _use_device() -> bool:
+    return os.environ.get("RACON_TPU_DEVICE_ALIGNER", "1") != "0"
+
+
+def run_alignment_phase(pipeline, progress: bool = False) -> dict:
+    stats = {"device": 0, "host": 0}
+    n = pipeline.num_align_jobs()
+    if n and _use_device():
+        from . import align
+
+        lengths = pipeline.align_job_lengths()
+        jobs = [i for i in range(n)
+                if align.device_eligible(lengths[i, 0], lengths[i, 1])]
+        if jobs:
+            stats["device"] = align.run_jobs(pipeline, jobs)
+    # Host finishes everything still CIGAR-less (device-rejected or
+    # ineligible).
+    pipeline.align_jobs_cpu()
+    stats["host"] = n - stats["device"]
+    return stats

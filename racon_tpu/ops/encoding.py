@@ -1,0 +1,31 @@
+"""Base encoding shared by the device kernels.
+
+ASCII bases map to codes A=0, C=1, G=2, T=3; every other character
+(N, IUPAC ambiguity codes, '-') collapses to 4. Divergence from the host
+path: the host compares raw characters, so two distinct ambiguity codes
+mismatch there but compare equal (4==4) on device — irrelevant for ACGT data
+and pinned separately in the golden tests, the same way the reference pins
+its CUDA deltas (/root/reference/test/racon_test.cpp:297-507).
+"""
+
+import numpy as np
+
+_LUT = np.full(256, 4, dtype=np.uint8)
+for i, c in enumerate(b"ACGT"):
+    _LUT[c] = i
+# lowercase never reaches the kernels (Sequence uppercases on parse), but be
+# safe for direct-API users
+for i, c in enumerate(b"acgt"):
+    _LUT[c] = i
+
+_DECODE = np.frombuffer(b"ACGTN", dtype=np.uint8)
+
+
+def encode(ascii_bases: np.ndarray) -> np.ndarray:
+    """uint8 ASCII -> uint8 codes 0..4."""
+    return _LUT[ascii_bases]
+
+
+def decode(codes: np.ndarray) -> bytes:
+    """uint8/int codes 0..4 -> ASCII bytes."""
+    return _DECODE[np.asarray(codes, dtype=np.int64).clip(0, 4)].tobytes()

@@ -1,0 +1,172 @@
+"""Consensus-phase driver: packs windows into padded, depth-bucketed device
+batches, runs the batched POA kernel, trims and installs results, and
+re-runs anything the device rejected on the host POA engine.
+
+Mirrors the reference's CUDA polish orchestration
+(/root/reference/src/cuda/cudapolisher.cpp:216-378): depth cap per window
+(MAX_DEPTH_PER_WINDOW=200, :226), per-entry rejection of oversized layers
+(cudabatch.cpp:141-160), failed windows re-polished on the host
+(:354-378), and the host-side trim identical to the CPU path
+(cudabatch.cpp:230-256).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+import numpy as np
+
+from . import poa
+from .encoding import decode, encode
+
+DEPTH_CAP = 200                    # reference: MAX_DEPTH_PER_WINDOW
+DEPTH_BUCKETS = (8, 32, DEPTH_CAP)
+
+
+def _batch_size() -> int:
+    env = os.environ.get("RACON_TPU_BATCH_WINDOWS")
+    if env:
+        return max(1, int(env))
+    import jax
+    return 16 if jax.devices()[0].platform == "tpu" else 4
+
+
+def make_config(window_length: int, depth: int, match: int, mismatch: int,
+                gap: int) -> poa.PoaConfig:
+    def ceil128(x):
+        return (x + 127) // 128 * 128
+
+    max_backbone = ceil128(window_length)
+    max_len = ceil128(window_length + window_length // 2)
+    max_nodes = ceil128(3 * window_length)
+    return poa.PoaConfig(max_nodes=max_nodes, max_len=max_len,
+                         max_backbone=max_backbone, max_edges=12,
+                         depth=depth, match=match, mismatch=mismatch,
+                         gap=gap)
+
+
+def tgs_trim(codes: np.ndarray, cov: np.ndarray, n_seqs: int):
+    """Low-coverage end trim (reference: src/window.cpp:125-146)."""
+    avg = (n_seqs - 1) // 2
+    n = len(codes)
+    begin = 0
+    while begin < n and cov[begin] < avg:
+        begin += 1
+    end = n - 1
+    while end >= 0 and cov[end] < avg:
+        end -= 1
+    if begin >= end:
+        return codes  # chimeric suspicion: keep untrimmed
+    return codes[begin:end + 1]
+
+
+def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
+                        trim: bool, progress: bool = False) -> dict:
+    """Device consensus for every eligible window; host for the rest.
+
+    Returns stats {device:…, host_fallback:…, backbone:…}.
+    """
+    n = pipeline.num_windows()
+    stats = {"device": 0, "host_fallback": 0, "backbone": 0, "failed": 0}
+
+    jobs = []          # (window_idx, export, kept layer indices)
+    fallback: List[int] = []
+    window_length = 0
+
+    probe_cfg = make_config(512, 8, match, mismatch, gap)  # for max_len only
+
+    for i in range(n):
+        wx = pipeline.export_window(i)
+        window_length = max(window_length, len(wx.backbone))
+        k = len(wx.lens)
+        if k < 2:
+            # <3 sequences incl. backbone: backbone passthrough
+            # (reference: src/window.cpp:68-71)
+            pipeline.set_consensus(i, wx.backbone.tobytes(), False)
+            stats["backbone"] += 1
+            continue
+        keep = [j for j in range(k) if 0 < wx.lens[j] <= probe_cfg.max_len]
+        if len(keep) < len(wx.lens[:DEPTH_CAP]) and len(keep) < 2:
+            # device can't represent enough of this window: host it
+            fallback.append(i)
+            continue
+        keep = keep[:DEPTH_CAP]
+        jobs.append((i, wx, keep))
+
+    if jobs:
+        B = _batch_size()
+        # Bucket by depth to bound padding waste.
+        buckets = {}
+        for job in jobs:
+            depth = len(job[2])
+            bucket = next(b for b in DEPTH_BUCKETS if depth <= b)
+            buckets.setdefault(bucket, []).append(job)
+
+        for depth_bucket, bucket_jobs in sorted(buckets.items()):
+            cfg = make_config(max(window_length, 1), depth_bucket, match,
+                              mismatch, gap)
+            kernel = poa.build_poa_kernel(cfg)
+            for off in range(0, len(bucket_jobs), B):
+                chunk = bucket_jobs[off:off + B]
+                _run_chunk(pipeline, kernel, cfg, chunk, trim, stats,
+                           fallback)
+            if progress:
+                print(f"[racon_tpu::poa] bucket depth<={depth_bucket}: "
+                      f"{len(bucket_jobs)} windows", file=sys.stderr)
+
+    for i in fallback:
+        pipeline.consensus_cpu_one(i)
+        stats["host_fallback"] += 1
+
+    return stats
+
+
+def _run_chunk(pipeline, kernel, cfg, chunk, trim, stats, fallback):
+    B = len(chunk)
+    bb = np.zeros((B, cfg.max_backbone), dtype=np.uint8)
+    bbw = np.zeros((B, cfg.max_backbone), dtype=np.int32)
+    bb_len = np.zeros(B, dtype=np.int32)
+    n_layers = np.zeros(B, dtype=np.int32)
+    seqs = np.zeros((B, cfg.depth, cfg.max_len), dtype=np.uint8)
+    ws = np.zeros((B, cfg.depth, cfg.max_len), dtype=np.int32)
+    lens = np.zeros((B, cfg.depth), dtype=np.int32)
+    begins = np.zeros((B, cfg.depth), dtype=np.int32)
+    ends = np.zeros((B, cfg.depth), dtype=np.int32)
+
+    for bi, (i, wx, keep) in enumerate(chunk):
+        L = len(wx.backbone)
+        bb[bi, :L] = encode(wx.backbone)
+        bbw[bi, :L] = wx.backbone_weights
+        bb_len[bi] = L
+        n_layers[bi] = len(keep)
+        offsets = np.concatenate([[0], np.cumsum(wx.lens)]).astype(np.int64)
+        for li, j in enumerate(keep):
+            ll = int(wx.lens[j])
+            seqs[bi, li, :ll] = encode(wx.bases[offsets[j]:offsets[j] + ll])
+            ws[bi, li, :ll] = wx.weights[offsets[j]:offsets[j] + ll]
+            lens[bi, li] = ll
+            begins[bi, li] = wx.begins[j]
+            ends[bi, li] = wx.ends[j]
+
+    cons_base, cons_cov, cons_len, failed, _ = (
+        np.asarray(x) for x in kernel(bb, bbw, bb_len, n_layers, seqs, ws,
+                                      lens, begins, ends))
+
+    for bi, (i, wx, keep) in enumerate(chunk):
+        if failed[bi]:
+            fallback.append(i)
+            stats["failed"] += 1
+            continue
+        cl = int(cons_len[bi])
+        codes = cons_base[bi, :cl]
+        cov = cons_cov[bi, :cl]
+        out = np.asarray(codes)
+        if wx.is_tgs and trim:
+            keep_mask_len = len(keep) + 1  # incorporated sequences incl. backbone
+            kept_codes = tgs_trim(out, np.asarray(cov), keep_mask_len)
+        else:
+            kept_codes = out
+        pipeline.set_consensus(i, decode(kept_codes), True)
+        stats["device"] += 1

@@ -1,0 +1,7 @@
+"""Multi-chip parallelism: window batches are sharded data-parallel over a
+`jax.sharding.Mesh` (windows are independent POA problems — the reference's
+multi-GPU batch striping, src/cuda/cudapolisher.cpp:165-180,228-240, maps to
+batch-dim sharding over ICI; multi-host scales by sharding contigs/windows
+over DCN with an ordered host gather, no collectives needed)."""
+
+from .mesh import device_mesh, shard_batch_kernel  # noqa: F401

@@ -1,0 +1,111 @@
+"""Golden end-to-end accuracy tests on the lambda-phage dataset — the same
+strategy as the reference suite (/root/reference/test/racon_test.cpp:86-295):
+run the full pipeline, pin the exact edit distance of the polished contig
+(reverse-complemented) against NC_001416, pin output counts/lengths for
+fragment correction.
+
+Our pinned numbers sit next to the reference's for comparison (this
+framework's POA/aligner are new implementations, so the numbers differ the
+way the reference's own CUDA numbers differ from its CPU numbers):
+
+  scenario                      ours   reference-CPU  reference-GPU
+  PAF + qualities               1353   1312           1385
+  PAF no qualities              1516   1566           1607
+  SAM + qualities               1354   1317           1541
+  SAM no qualities              1856   1770           1661
+  PAF + qualities, w=1000       1351   1289           4168
+  PAF + qualities, unit scores  1324   1321           1361
+  fragment kC count/bp          40/401223   40/401246
+  fragment kF PAF count/bp      236/1658853 236/1658216
+
+Slow scenarios (host global alignment of every all-vs-all overlap on this
+1-core box) are gated behind RACON_TPU_FULL_GOLDEN=1.
+"""
+
+import os
+
+import pytest
+
+import racon_tpu
+from racon_tpu import native
+from tests.conftest import DATA, revcomp
+
+FULL = os.environ.get("RACON_TPU_FULL_GOLDEN") == "1"
+
+ARGS = dict(window_length=500, quality_threshold=10.0, error_threshold=0.3,
+            match=5, mismatch=-4, gap=-8, num_threads=1)
+
+
+def polish(seqs, ovl, tgt, backend="cpu", drop=True, **kw):
+    a = dict(ARGS)
+    a.update(kw)
+    p = racon_tpu.create_polisher(DATA + seqs, DATA + ovl, DATA + tgt,
+                                  backend=backend, **a)
+    p.initialize()
+    return p.polish(drop)
+
+
+def ed_vs_reference(res, lambda_reference):
+    assert len(res) == 1
+    return native.edit_distance(revcomp(res[0][1].encode()), lambda_reference)
+
+
+def test_consensus_sam_with_qualities(lambda_reference):
+    res = polish("sample_reads.fastq.gz", "sample_overlaps.sam.gz",
+                 "sample_layout.fasta.gz")
+    assert ed_vs_reference(res, lambda_reference) == 1354  # reference: 1317
+
+
+def test_consensus_sam_without_qualities(lambda_reference):
+    res = polish("sample_reads.fasta.gz", "sample_overlaps.sam.gz",
+                 "sample_layout.fasta.gz")
+    assert ed_vs_reference(res, lambda_reference) == 1856  # reference: 1770
+
+
+def test_consensus_paf_with_qualities(lambda_reference):
+    res = polish("sample_reads.fastq.gz", "sample_overlaps.paf.gz",
+                 "sample_layout.fasta.gz")
+    assert ed_vs_reference(res, lambda_reference) == 1353  # reference: 1312
+
+
+@pytest.mark.skipif(not FULL, reason="slow on 1-core host; "
+                    "set RACON_TPU_FULL_GOLDEN=1")
+def test_consensus_paf_without_qualities(lambda_reference):
+    res = polish("sample_reads.fasta.gz", "sample_overlaps.paf.gz",
+                 "sample_layout.fasta.gz")
+    assert ed_vs_reference(res, lambda_reference) == 1516  # reference: 1566
+
+
+@pytest.mark.skipif(not FULL, reason="slow on 1-core host; "
+                    "set RACON_TPU_FULL_GOLDEN=1")
+def test_consensus_paf_larger_window(lambda_reference):
+    res = polish("sample_reads.fastq.gz", "sample_overlaps.paf.gz",
+                 "sample_layout.fasta.gz", window_length=1000)
+    assert ed_vs_reference(res, lambda_reference) == 1351  # reference: 1289
+
+
+@pytest.mark.skipif(not FULL, reason="slow on 1-core host; "
+                    "set RACON_TPU_FULL_GOLDEN=1")
+def test_consensus_paf_unit_scores(lambda_reference):
+    res = polish("sample_reads.fastq.gz", "sample_overlaps.paf.gz",
+                 "sample_layout.fasta.gz", match=1, mismatch=-1, gap=-1)
+    assert ed_vs_reference(res, lambda_reference) == 1324  # reference: 1321
+
+
+@pytest.mark.skipif(not FULL, reason="slow on 1-core host; "
+                    "set RACON_TPU_FULL_GOLDEN=1")
+def test_fragment_correction_kc(lambda_reference):
+    res = polish("sample_reads.fastq.gz", "sample_ava_overlaps.paf.gz",
+                 "sample_reads.fastq.gz", match=1, mismatch=-1, gap=-1)
+    assert len(res) == 40  # reference: 40
+    assert sum(len(d) for _, d in res) == 401223  # reference: 401246
+
+
+@pytest.mark.skipif(not FULL, reason="very slow on 1-core host; "
+                    "set RACON_TPU_FULL_GOLDEN=1")
+def test_fragment_correction_kf_paf(lambda_reference):
+    res = polish("sample_reads.fastq.gz", "sample_ava_overlaps.paf.gz",
+                 "sample_reads.fastq.gz", fragment_correction=True,
+                 match=1, mismatch=-1, gap=-1, drop=False)
+    assert len(res) == 236  # reference: 236
+    assert sum(len(d) for _, d in res) == 1658853  # reference: 1658216
