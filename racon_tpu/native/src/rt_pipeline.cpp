@@ -128,6 +128,7 @@ void Pipeline::prepare() {
     id_to_id[i << 1 | 1] = i;
   }
 
+  logger_.log("[racon_tpu::Pipeline::initialize] loaded target sequences");
   std::vector<bool> has_name(targets_size_, true);
   std::vector<bool> has_data(targets_size_, true);
   std::vector<bool> has_reverse_data(targets_size_, false);
@@ -174,6 +175,7 @@ void Pipeline::prepare() {
   has_data.resize(sequences_.size(), false);
   has_reverse_data.resize(sequences_.size(), false);
 
+  logger_.log("[racon_tpu::Pipeline::initialize] loaded sequences");
   // Short reads get NGS windows (no trim), long reads TGS
   // (parity: src/polisher.cpp:277-278).
   window_type_ = static_cast<double>(total_reads_length) / read_ordinal <= 1000
@@ -255,6 +257,7 @@ void Pipeline::prepare() {
     }
   }
 
+  logger_.log("[racon_tpu::Pipeline::initialize] loaded overlaps");
   // Collect alignment jobs (overlaps without a CIGAR).
   for (size_t i = 0; i < overlaps_.size(); ++i) {
     if (overlaps_[i]->cigar.empty()) {
@@ -286,8 +289,19 @@ void Pipeline::align_jobs_cpu() {
       o->cigar = align_global_cigar(q, q_len, t, t_len);
     }));
   }
-  for (auto& f : futs) {
-    f.wait();
+  // 20-bin progress bar over alignment jobs
+  // (parity: src/polisher.cpp:476-487).
+  const size_t step = futs.size() / 20;
+  for (size_t i = 0; i < futs.size(); ++i) {
+    futs[i].wait();
+    if (step != 0 && (i + 1) % step == 0 && (i + 1) / step < 20) {
+      logger_.bar("[racon_tpu::Pipeline::initialize] aligning overlaps");
+    }
+  }
+  if (step != 0) {
+    logger_.bar("[racon_tpu::Pipeline::initialize] aligning overlaps");
+  } else if (!futs.empty()) {
+    logger_.log("[racon_tpu::Pipeline::initialize] aligned overlaps");
   }
 }
 
@@ -382,6 +396,9 @@ void Pipeline::build_windows() {
 
   done_.assign(windows_.size(), 0);
   polished_.assign(windows_.size(), 0);
+
+  logger_.log("[racon_tpu::Pipeline::initialize] transformed data into "
+              "windows");
 }
 
 void Pipeline::initialize() {
@@ -406,8 +423,17 @@ void Pipeline::consensus_cpu_all() {
     }
     futs.emplace_back(pool_->submit([this, i] { consensus_cpu_one(i); }));
   }
-  for (auto& f : futs) {
-    f.wait();
+  const size_t step = futs.size() / 20;
+  for (size_t i = 0; i < futs.size(); ++i) {
+    futs[i].wait();
+    if (step != 0 && (i + 1) % step == 0 && (i + 1) / step < 20) {
+      logger_.bar("[racon_tpu::Pipeline::polish] generating consensus");
+    }
+  }
+  if (step != 0) {
+    logger_.bar("[racon_tpu::Pipeline::polish] generating consensus");
+  } else if (!futs.empty()) {
+    logger_.log("[racon_tpu::Pipeline::polish] generated consensus");
   }
 }
 

@@ -23,6 +23,7 @@
 #include <string>
 #include <vector>
 
+#include "rt_logger.hpp"
 #include "rt_overlap.hpp"
 #include "rt_parsers.hpp"
 #include "rt_poa.hpp"
@@ -50,6 +51,8 @@ class Pipeline {
   // invalid parameters (parity: src/polisher.cpp:57-135).
   Pipeline(const std::string& sequences_path, const std::string& overlaps_path,
            const std::string& target_path, const PipelineParams& params);
+
+  ~Pipeline() { logger_.total("[racon_tpu::Pipeline::] total ="); }
 
   // ---- phase 1: data preparation -----------------------------------------
   // Parse + dedup + transmute + filter; stops right before overlap
@@ -116,6 +119,7 @@ class Pipeline {
 
   std::unique_ptr<ThreadPool> pool_;
   std::vector<std::unique_ptr<PoaAligner>> aligners_;  // one per thread
+  Logger logger_;
 };
 
 }  // namespace rt

@@ -96,7 +96,12 @@ def _dp_matrix(cfg: PoaConfig, g: Graph, seq, sub_mask, order, n_sub):
     H0 = jnp.full((N + 1, L + 1), NEG, dtype=jnp.int32)
     H0 = H0.at[0].set(jj * gp)
 
-    def body(r, H):
+    def cond(c):
+        r, _ = c
+        return r < n_sub
+
+    def body(c):
+        r, H = c
         u = order[r]
         ub = g.base[u]
         srcs = g.in_src[u]
@@ -116,11 +121,9 @@ def _dp_matrix(cfg: PoaConfig, g: Graph, seq, sub_mask, order, n_sub):
         # Linear-gap horizontal pass: H[j] = j*g + cummax(V[j] - j*g).
         tr = V - jj * gp
         row = jax.lax.cummax(tr) + jj * gp
+        return (r + 1, H.at[u + 1].set(row))
 
-        do = r < n_sub
-        return jax.lax.cond(do, lambda: H.at[u + 1].set(row), lambda: H)
-
-    return jax.lax.fori_loop(0, N, body, H0)
+    return jax.lax.while_loop(cond, body, (jnp.int32(0), H0))[1]
 
 
 def _traceback(cfg: PoaConfig, g: Graph, H, seq, sub_mask, order, n_sub, L):
@@ -220,8 +223,8 @@ def _update_graph(cfg: PoaConfig, g: Graph, pos_node, seq, w, L):
     next_key = next_key[::-1]
     run_rem = run_rem[::-1]
 
-    def body(carry, j):
-        g, prev, prev_key, prev_w = carry
+    def body(carry):
+        g, prev, prev_key, prev_w, j = carry
         act = active[j]
         b = seq[j].astype(jnp.int32)
         wj = w[j]
@@ -271,10 +274,12 @@ def _update_graph(cfg: PoaConfig, g: Graph, pos_node, seq, w, L):
         prev_key = jnp.where(act, key[nid], prev_key)
         prev_w = jnp.where(act, wj, prev_w)
         g2 = Graph(base, key, cov, in_src, in_w, n, failed)
-        return (g2, prev, prev_key, prev_w), None
+        return (g2, prev, prev_key, prev_w, j + 1)
 
-    (g, _, _, _), _ = jax.lax.scan(
-        body, (g, jnp.int32(-1), jnp.float32(-1.0), jnp.int32(0)), jj)
+    g = jax.lax.while_loop(
+        lambda c: c[4] < L,
+        body,
+        (g, jnp.int32(-1), jnp.float32(-1.0), jnp.int32(0), jnp.int32(0)))[0]
     return g
 
 
@@ -303,8 +308,8 @@ def _consensus(cfg: PoaConfig, g: Graph):
     N = cfg.max_nodes
     order = jnp.argsort(g.key).astype(jnp.int32)
 
-    def score_body(r, sp):
-        score, pred = sp
+    def score_body(c):
+        r, score, pred = c
         u = order[r]
         srcs = g.in_src[u]
         srcs_c = jnp.maximum(srcs, 0)
@@ -317,14 +322,12 @@ def _consensus(cfg: PoaConfig, g: Graph):
         slot = jnp.argmax(jnp.where(cand, ps, NEG))
         s = jnp.where(any_valid, wmax + ps[slot], 0)
         p = jnp.where(any_valid, srcs[slot], -1)
-        do = r < g.n
-        score = score.at[u].set(jnp.where(do, s, score[u]))
-        pred = pred.at[u].set(jnp.where(do, p, pred[u]))
-        return score, pred
+        return (r + 1, score.at[u].set(s), pred.at[u].set(p))
 
     score0 = jnp.zeros(N, dtype=jnp.int32)
     pred0 = jnp.full(N, -1, dtype=jnp.int32)
-    score, pred = jax.lax.fori_loop(0, N, score_body, (score0, pred0))
+    _, score, pred = jax.lax.while_loop(
+        lambda c: c[0] < g.n, score_body, (jnp.int32(0), score0, pred0))
 
     rr = jnp.arange(N, dtype=jnp.int32)
     score_by_rank = jnp.where(rr < g.n, score[order], NEG)
@@ -383,19 +386,22 @@ def _polish_window(cfg: PoaConfig, bb_codes, bb_w, bb_len, n_layers,
     """Full per-window program: init graph, fold in layers, consensus."""
     g = _init_graph(cfg, bb_codes, bb_w, bb_len)
 
-    def layer_body(carry, xs):
-        g = carry
-        seq, w, L, begin, end, li = xs
-        use = (li < n_layers) & (L > 0) & ~g.failed
+    def layer_body(c):
+        g, li = c
+        seq = seqs[li]
+        w = ws[li]
+        L = lens[li]
+        use = (L > 0) & ~g.failed
         g = jax.lax.cond(
             use,
-            lambda g: _add_layer(cfg, g, seq, w, L, begin, end, bb_len),
+            lambda g: _add_layer(cfg, g, seq, w, L, begins[li], ends[li],
+                                 bb_len),
             lambda g: g,
             g)
-        return g, None
+        return (g, li + 1)
 
-    li = jnp.arange(cfg.depth, dtype=jnp.int32)
-    g, _ = jax.lax.scan(layer_body, g, (seqs, ws, lens, begins, ends, li))
+    g = jax.lax.while_loop(
+        lambda c: c[1] < n_layers, layer_body, (g, jnp.int32(0)))[0]
 
     cons_base, cons_cov, cons_len = _consensus(cfg, g)
     return cons_base, cons_cov, cons_len, g.failed, g.n

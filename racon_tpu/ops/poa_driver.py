@@ -30,7 +30,7 @@ def _batch_size() -> int:
     if env:
         return max(1, int(env))
     import jax
-    return 16 if jax.devices()[0].platform == "tpu" else 4
+    return 64 if jax.devices()[0].platform == "tpu" else 4
 
 
 def make_config(window_length: int, depth: int, match: int, mismatch: int,
@@ -71,15 +71,23 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
     n = pipeline.num_windows()
     stats = {"device": 0, "host_fallback": 0, "backbone": 0, "failed": 0}
 
-    jobs = []          # (window_idx, export, kept layer indices)
     fallback: List[int] = []
     window_length = 0
 
-    probe_cfg = make_config(512, 8, match, mismatch, gap)  # for max_len only
-
+    # First pass: export everything and find the batch geometry (the layer
+    # length cap depends on the final config, which depends on the largest
+    # backbone).
+    exports = []
     for i in range(n):
         wx = pipeline.export_window(i)
         window_length = max(window_length, len(wx.backbone))
+        exports.append(wx)
+
+    max_len = make_config(max(window_length, 1), DEPTH_BUCKETS[0], match,
+                          mismatch, gap).max_len
+
+    jobs = []          # (window_idx, export, kept layer indices)
+    for i, wx in enumerate(exports):
         k = len(wx.lens)
         if k < 2:
             # <3 sequences incl. backbone: backbone passthrough
@@ -87,7 +95,7 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
             pipeline.set_consensus(i, wx.backbone.tobytes(), False)
             stats["backbone"] += 1
             continue
-        keep = [j for j in range(k) if 0 < wx.lens[j] <= probe_cfg.max_len]
+        keep = [j for j in range(k) if 0 < wx.lens[j] <= max_len]
         if len(keep) < len(wx.lens[:DEPTH_CAP]) and len(keep) < 2:
             # device can't represent enough of this window: host it
             fallback.append(i)
@@ -97,6 +105,7 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
 
     if jobs:
         B = _batch_size()
+        use_pallas = _use_pallas()
         # Bucket by depth to bound padding waste.
         buckets = {}
         for job in jobs:
@@ -107,11 +116,23 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
         for depth_bucket, bucket_jobs in sorted(buckets.items()):
             cfg = make_config(max(window_length, 1), depth_bucket, match,
                               mismatch, gap)
-            kernel = poa.build_poa_kernel(cfg)
+            if use_pallas:
+                import jax
+
+                from . import poa_pallas
+                interp = jax.devices()[0].platform != "tpu"
+                kernel = poa_pallas.build_pallas_poa_kernel(
+                    cfg, interpret=interp)(B)
+            else:
+                kernel = poa.build_poa_kernel(cfg)
+            # Sequential loops run lock-step across the batch, so keep
+            # batches depth-homogeneous.
+            bucket_jobs.sort(key=lambda job: len(job[2]))
             for off in range(0, len(bucket_jobs), B):
                 chunk = bucket_jobs[off:off + B]
                 _run_chunk(pipeline, kernel, cfg, chunk, trim, stats,
-                           fallback)
+                           fallback, use_pallas=use_pallas,
+                           pad_to=B if use_pallas else None)
             if progress:
                 print(f"[racon_tpu::poa] bucket depth<={depth_bucket}: "
                       f"{len(bucket_jobs)} windows", file=sys.stderr)
@@ -123,11 +144,20 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
     return stats
 
 
-def _run_chunk(pipeline, kernel, cfg, chunk, trim, stats, fallback):
-    B = len(chunk)
+def _use_pallas() -> bool:
+    env = os.environ.get("RACON_TPU_PALLAS")
+    if env is not None:
+        return env == "1"
+    import jax
+    return jax.devices()[0].platform == "tpu"
+
+
+def _run_chunk(pipeline, kernel, cfg, chunk, trim, stats, fallback,
+               use_pallas=False, pad_to=None):
+    B = pad_to if pad_to is not None else len(chunk)
     bb = np.zeros((B, cfg.max_backbone), dtype=np.uint8)
     bbw = np.zeros((B, cfg.max_backbone), dtype=np.int32)
-    bb_len = np.zeros(B, dtype=np.int32)
+    bb_len = np.ones(B, dtype=np.int32)   # padded windows: 1-base backbone
     n_layers = np.zeros(B, dtype=np.int32)
     seqs = np.zeros((B, cfg.depth, cfg.max_len), dtype=np.uint8)
     ws = np.zeros((B, cfg.depth, cfg.max_len), dtype=np.int32)
@@ -150,9 +180,18 @@ def _run_chunk(pipeline, kernel, cfg, chunk, trim, stats, fallback):
             begins[bi, li] = wx.begins[j]
             ends[bi, li] = wx.ends[j]
 
-    cons_base, cons_cov, cons_len, failed, _ = (
-        np.asarray(x) for x in kernel(bb, bbw, bb_len, n_layers, seqs, ws,
-                                      lens, begins, ends))
+    if use_pallas:
+        cb, cc, cl, fl, _ = kernel(
+            bb_len[:, None], n_layers[:, None], lens, begins, ends,
+            bb.astype(np.int32), bbw, seqs.astype(np.int32), ws)
+        cons_base = np.asarray(cb)
+        cons_cov = np.asarray(cc)
+        cons_len = np.asarray(cl)[:, 0]
+        failed = np.asarray(fl)[:, 0]
+    else:
+        cons_base, cons_cov, cons_len, failed, _ = (
+            np.asarray(x) for x in kernel(bb, bbw, bb_len, n_layers, seqs,
+                                          ws, lens, begins, ends))
 
     for bi, (i, wx, keep) in enumerate(chunk):
         if failed[bi]:

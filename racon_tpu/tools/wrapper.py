@@ -1,0 +1,100 @@
+"""Outer workflow wrapper: subsample reads to a target coverage and/or split
+targets into byte-bounded chunks, then polish each chunk — for datasets too
+large for one pipeline pass.
+
+Capability parity with the reference wrapper
+(/root/reference/scripts/racon_wrapper.py): same flags (--split,
+--subsample REF_LEN COV), same work-directory lifecycle, chunks processed
+sequentially with results streamed to stdout. Instead of shelling out to a
+racon binary it drives the pipeline in-process; on multi-host deployments
+each chunk is independent, so chunks can be fanned out across hosts with a
+plain ordered gather (no collectives — see SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import time
+
+from . import sampler
+from ..polisher import create_polisher
+
+
+def eprint(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def run(args) -> int:
+    work_dir = os.path.join(
+        os.getcwd(), f"racon_tpu_work_directory_{time.time()}")
+    os.makedirs(work_dir, exist_ok=True)
+    try:
+        sequences = os.path.abspath(args.sequences)
+        if args.subsample is not None:
+            eprint("[racon_tpu::wrapper] subsampling sequences")
+            ref_len, cov = int(args.subsample[0]), int(args.subsample[1])
+            sequences = sampler.subsample(sequences, ref_len, cov, work_dir)
+
+        targets = [os.path.abspath(args.target_sequences)]
+        if args.split is not None:
+            eprint("[racon_tpu::wrapper] splitting target sequences")
+            targets = sampler.split(os.path.abspath(args.target_sequences),
+                                    int(args.split), work_dir)
+            eprint(f"[racon_tpu::wrapper] total number of splits: "
+                   f"{len(targets)}")
+
+        for part in targets:
+            eprint("[racon_tpu::wrapper] polishing chunk")
+            polisher = create_polisher(
+                sequences, os.path.abspath(args.overlaps), part,
+                backend="tpu" if args.tpu else "cpu",
+                fragment_correction=args.fragment_correction,
+                window_length=int(args.window_length),
+                quality_threshold=float(args.quality_threshold),
+                error_threshold=float(args.error_threshold),
+                match=int(args.match), mismatch=int(args.mismatch),
+                gap=int(args.gap), num_threads=int(args.threads))
+            polisher.initialize()
+            for name, data in polisher.polish(not args.include_unpolished):
+                sys.stdout.write(f">{name}\n{data}\n")
+        return 0
+    finally:
+        try:
+            shutil.rmtree(work_dir)
+        except OSError:
+            eprint("[racon_tpu::wrapper] warning: unable to clean work "
+                   "directory!")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="racon-tpu-wrapper",
+        description="racon-tpu with outer subsample/split workflow")
+    p.add_argument("sequences")
+    p.add_argument("overlaps")
+    p.add_argument("target_sequences")
+    p.add_argument("--split", help="split target sequences into chunks of "
+                   "desired size in bytes")
+    p.add_argument("--subsample", nargs=2, metavar=("REF_LEN", "COV"),
+                   help="subsample sequences to coverage COV given reference "
+                   "length REF_LEN")
+    p.add_argument("-u", "--include-unpolished", action="store_true")
+    p.add_argument("-f", "--fragment-correction", action="store_true")
+    p.add_argument("-w", "--window-length", default=500)
+    p.add_argument("-q", "--quality-threshold", default=10.0)
+    p.add_argument("-e", "--error-threshold", default=0.3)
+    # wrapper score defaults match the reference wrapper (m=5 x=-4 g=-8,
+    # scripts/racon_wrapper.py:188-193), not the binary's 3/-5/-4.
+    p.add_argument("-m", "--match", default=5)
+    p.add_argument("-x", "--mismatch", default=-4)
+    p.add_argument("-g", "--gap", default=-8)
+    p.add_argument("-t", "--threads", default=1)
+    p.add_argument("--tpu", action="store_true")
+    return run(p.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

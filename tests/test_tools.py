@@ -1,0 +1,89 @@
+"""Tool tests: sampler (rampler-equivalent), wrapper, preprocess."""
+
+import gzip
+import io
+import os
+import subprocess
+import sys
+
+import pytest
+
+from racon_tpu.tools import preprocess, sampler
+from tests.conftest import DATA, read_fasta_gz
+
+
+def _write_fasta(path, records):
+    with open(path, "w") as f:
+        for name, data in records:
+            f.write(f">{name}\n{data}\n")
+
+
+def test_split_chunks(tmp_path):
+    recs = [(f"r{i}", "ACGT" * 100) for i in range(10)]  # 400 bp each
+    src = tmp_path / "seqs.fasta"
+    _write_fasta(src, recs)
+    outs = sampler.split(str(src), 1000, str(tmp_path))
+    assert len(outs) == 4  # 3 records (1200bp) per chunk
+    total = []
+    for o in outs:
+        assert os.path.basename(o).startswith("seqs_")
+        with open(o) as f:
+            total += [l for l in f if l.startswith(">")]
+    assert len(total) == 10
+
+
+def test_subsample_respects_target(tmp_path):
+    recs = [(f"r{i}", "ACGT" * 250) for i in range(20)]  # 1000 bp each
+    src = tmp_path / "reads.fastq"
+    with open(src, "w") as f:
+        for name, data in recs:
+            f.write(f"@{name}\n{data}\n+\n{'I' * len(data)}\n")
+    out = sampler.subsample(str(src), 1000, 5, str(tmp_path))
+    assert out.endswith("reads_5x.fastq")
+    n = sum(1 for l in open(out) if l.startswith("@"))
+    assert 5 <= n <= 6  # ~5000 bases at 1000 bp each, one overshoot allowed
+
+
+def test_subsample_keeps_all_when_under_target(tmp_path):
+    recs = [(f"r{i}", "ACGT" * 10) for i in range(3)]
+    src = tmp_path / "reads.fasta"
+    _write_fasta(src, recs)
+    out = sampler.subsample(str(src), 100000, 30, str(tmp_path))
+    assert sum(1 for l in open(out) if l.startswith(">")) == 3
+
+
+def test_preprocess_renames_pairs(tmp_path, capsys):
+    fq = tmp_path / "pairs.fastq"
+    with open(fq, "w") as f:
+        f.write("@read extra\nACGT\n+\nIIII\n@read extra\nTTTT\n+\nIIII\n")
+    read_set = set()
+    buf = io.StringIO()
+    preprocess.parse_file(str(fq), read_set, buf)
+    lines = buf.getvalue().splitlines()
+    assert lines[0] == "@read1"
+    assert lines[4] == "@read2"
+
+
+def test_wrapper_end_to_end(tmp_path):
+    """Wrapper (with --split: splitting is record-granular, so the single
+    47.9kb layout record stays one chunk) polishes to the expected contig."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo)
+    out = subprocess.run(
+        [sys.executable, "-m", "racon_tpu.tools.wrapper",
+         "--split", "30000",
+         "-m", "5", "-x", "-4", "-g", "-8",
+         DATA + "sample_reads.fastq.gz", DATA + "sample_overlaps.sam.gz",
+         DATA + "sample_layout.fasta.gz"],
+        capture_output=True, text=True, timeout=600,
+        cwd=str(tmp_path), env=env)
+    assert out.returncode == 0, out.stderr
+    names = [l for l in out.stdout.splitlines() if l.startswith(">")]
+    assert len(names) == 1
+    assert names[0].startswith(">utg000001l")
+    total = sum(len(l) for l in out.stdout.splitlines()
+                if not l.startswith(">"))
+    assert 45000 < total < 50000
+    # work directory cleaned up
+    assert not any(d.startswith("racon_tpu_work_directory")
+                   for d in os.listdir(tmp_path))
