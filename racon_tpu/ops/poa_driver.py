@@ -104,7 +104,10 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
         jobs.append((i, wx, keep))
 
     if jobs:
-        B = _batch_size()
+        from ..parallel.mesh import divisible_batch
+
+        n_dev = _n_devices()
+        B = divisible_batch(n_dev, _batch_size())
         use_pallas = _use_pallas()
         # Bucket by depth to bound padding waste.
         buckets = {}
@@ -116,23 +119,15 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
         for depth_bucket, bucket_jobs in sorted(buckets.items()):
             cfg = make_config(max(window_length, 1), depth_bucket, match,
                               mismatch, gap)
-            if use_pallas:
-                import jax
-
-                from . import poa_pallas
-                interp = jax.devices()[0].platform != "tpu"
-                kernel = poa_pallas.build_pallas_poa_kernel(
-                    cfg, interpret=interp)(B)
-            else:
-                kernel = poa.build_poa_kernel(cfg)
+            kernel = _build_kernel(cfg, B, use_pallas)
             # Sequential loops run lock-step across the batch, so keep
             # batches depth-homogeneous.
             bucket_jobs.sort(key=lambda job: len(job[2]))
+            pad = B if (use_pallas or n_dev > 1) else None
             for off in range(0, len(bucket_jobs), B):
                 chunk = bucket_jobs[off:off + B]
                 _run_chunk(pipeline, kernel, cfg, chunk, trim, stats,
-                           fallback, use_pallas=use_pallas,
-                           pad_to=B if use_pallas else None)
+                           fallback, use_pallas=use_pallas, pad_to=pad)
             if progress:
                 print(f"[racon_tpu::poa] bucket depth<={depth_bucket}: "
                       f"{len(bucket_jobs)} windows", file=sys.stderr)
@@ -150,6 +145,44 @@ def _use_pallas() -> bool:
         return env == "1"
     import jax
     return jax.devices()[0].platform == "tpu"
+
+
+def _n_devices() -> int:
+    import jax
+    return len(jax.devices())
+
+
+def _build_kernel(cfg, B, use_pallas):
+    """Single- or multi-device kernel for a B-window batch.
+
+    Multi-device: batch dim sharded over the 1-D `windows` mesh — the
+    production analogue of the reference's multi-GPU batch striping
+    (src/cuda/cudapolisher.cpp:228-240), with no collectives.
+    """
+    import jax
+
+    n_dev = _n_devices()
+    if use_pallas:
+        from . import poa_pallas
+        interp = jax.devices()[0].platform != "tpu"
+        if n_dev == 1:
+            return poa_pallas.build_pallas_poa_kernel(cfg, interpret=interp)(B)
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import AXIS, device_mesh
+        mesh = device_mesh()
+        local = poa_pallas.build_pallas_poa_kernel(cfg, interpret=interp)(
+            B // n_dev)
+        spec = P(AXIS)
+        return jax.jit(jax.shard_map(
+            lambda *args: local(*args), mesh=mesh,
+            in_specs=(spec,) * 9, out_specs=(spec,) * 5,
+            check_vma=False))
+    kernel = poa.build_poa_kernel(cfg)
+    if n_dev == 1:
+        return kernel
+    from ..parallel.mesh import device_mesh, shard_batch_kernel
+    return shard_batch_kernel(kernel, device_mesh(), 9)
 
 
 def _run_chunk(pipeline, kernel, cfg, chunk, trim, stats, fallback,

@@ -32,7 +32,8 @@ def shard_batch_kernel(fn, mesh: Mesh, n_in: int):
                    out_shardings=batch)
 
 
-def pad_batch_to(mesh: Mesh, b: int) -> int:
-    """Batch sizes must divide evenly over the mesh."""
-    n = mesh.devices.size
-    return ((b + n - 1) // n) * n
+def divisible_batch(n_devices: int, b: int) -> int:
+    """Largest batch size <= max(b, n_devices) that divides evenly over the
+    mesh (the consensus driver rounds DOWN so per-device memory stays within
+    the configured budget)."""
+    return max(1, b // n_devices) * n_devices

@@ -101,6 +101,18 @@ def test_fragment_correction_kc(lambda_reference):
     assert sum(len(d) for _, d in res) == 401223  # reference: 401246
 
 
+@pytest.mark.skipif(not FULL, reason="slow (device path in interpret/CPU "
+                    "mode); set RACON_TPU_FULL_GOLDEN=1")
+def test_device_path_paf_with_qualities(lambda_reference):
+    """TPU-path golden (pinned the way the reference pins its CUDA numbers
+    against CPU, test/racon_test.cpp:297-318). Runs the pure-JAX kernels on
+    the CPU backend; on real TPU hardware the same path returns the
+    identical result (verified on-chip)."""
+    res = polish("sample_reads.fastq.gz", "sample_overlaps.paf.gz",
+                 "sample_layout.fasta.gz", backend="tpu")
+    assert ed_vs_reference(res, lambda_reference) == 1350  # host: 1353
+
+
 @pytest.mark.skipif(not FULL, reason="very slow on 1-core host; "
                     "set RACON_TPU_FULL_GOLDEN=1")
 def test_fragment_correction_kf_paf(lambda_reference):
