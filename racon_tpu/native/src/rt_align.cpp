@@ -12,6 +12,7 @@ namespace rt {
 namespace {
 
 constexpr int32_t kInf = std::numeric_limits<int32_t>::max() / 4;
+constexpr uint64_t kHigh = 1ull << 63;
 
 // Append `count` copies of `op` to a CIGAR under construction (run-length).
 void push_op(std::string& cigar, char op, uint32_t count) {
@@ -22,11 +23,64 @@ void push_op(std::string& cigar, char op, uint32_t count) {
   cigar += op;
 }
 
+// Run-length encode reversed op characters into a forward CIGAR.
+std::string cigar_from_reversed_ops(const std::string& rev_ops) {
+  std::string cigar;
+  uint32_t run = 0;
+  char run_op = 0;
+  for (auto it = rev_ops.rbegin(); it != rev_ops.rend(); ++it) {
+    if (*it == run_op) {
+      ++run;
+    } else {
+      push_op(cigar, run_op, run);
+      run_op = *it;
+      run = 1;
+    }
+  }
+  push_op(cigar, run_op, run);
+  return cigar;
+}
+
+// One Myers/Hyyro bit-parallel block step (64 rows of one DP column).
+// Updates vp/vn in place; returns the horizontal delta out of the block's
+// bottom row.
+inline int myers_block_step(uint64_t eq, uint64_t& vp, uint64_t& vn,
+                            int hin) {
+  const uint64_t pv = vp, mv = vn;
+  const uint64_t xv = eq | mv;
+  if (hin < 0) {
+    eq |= 1;
+  }
+  const uint64_t xh = (((eq & pv) + pv) ^ pv) | eq;
+  uint64_t ph = mv | ~(xh | pv);
+  uint64_t mh = pv & xh;
+  int hout = 0;
+  if (ph & kHigh) {
+    hout = 1;
+  } else if (mh & kHigh) {
+    hout = -1;
+  }
+  ph <<= 1;
+  mh <<= 1;
+  if (hin < 0) {
+    mh |= 1;
+  } else if (hin > 0) {
+    ph |= 1;
+  }
+  vp = mh | ~(xv | ph);
+  vn = ph & xv;
+  return hout;
+}
+
 }  // namespace
 
-// Banded unit-cost NW over diagonals d = j - i, d in [dmin, dmax].
-// Traceback moves: 0 = diag (M), 1 = left (D, consumes target),
-// 2 = up (I, consumes query). Directions are packed 4-per-byte.
+namespace {
+std::string myers_banded_cigar(const char* q, uint32_t n, const char* t,
+                               uint32_t m, int64_t dist);
+std::string scalar_banded_cigar(const char* q, uint32_t q_len, const char* t,
+                                uint32_t t_len, int64_t dist_exact);
+}  // namespace
+
 std::string align_global_cigar(const char* q, uint32_t q_len, const char* t,
                                uint32_t t_len) {
   if (q_len == 0 || t_len == 0) {
@@ -36,10 +90,30 @@ std::string align_global_cigar(const char* q, uint32_t q_len, const char* t,
     return cigar;
   }
 
-  const int64_t diff = static_cast<int64_t>(t_len) - static_cast<int64_t>(q_len);
   // One bit-parallel distance pass first: the exact distance gives an exact
-  // band, so the DP+traceback pass runs exactly once with no retries.
+  // band, so the path pass runs exactly once with no retries.
   const int64_t dist_exact = edit_distance(q, q_len, t, t_len);
+
+  // Large problems: banded block-Myers with popcount traceback
+  // (edlib-class throughput). Small problems: plain banded scalar DP.
+  if (static_cast<uint64_t>(q_len) * t_len > (1ull << 22)) {
+    std::string cigar = myers_banded_cigar(q, q_len, t, t_len, dist_exact);
+    if (!cigar.empty()) {
+      return cigar;
+    }
+    // verification failed (shouldn't happen): fall through to scalar DP
+  }
+  return scalar_banded_cigar(q, q_len, t, t_len, dist_exact);
+}
+
+namespace {
+
+// Banded unit-cost NW over diagonals d = j - i, d in [dmin, dmax].
+// Traceback moves: 0 = diag (M), 1 = left (D, consumes target),
+// 2 = up (I, consumes query). Directions are packed 4-per-byte.
+std::string scalar_banded_cigar(const char* q, uint32_t q_len, const char* t,
+                                uint32_t t_len, int64_t dist_exact) {
+  const int64_t diff = static_cast<int64_t>(t_len) - static_cast<int64_t>(q_len);
   int64_t k = std::max<int64_t>(1, dist_exact);
   const int64_t k_cap =
       static_cast<int64_t>(std::max(q_len, t_len)) + 1;
@@ -146,24 +220,154 @@ std::string align_global_cigar(const char* q, uint32_t q_len, const char* t,
         }
       }
 
-      std::string cigar;
-      uint32_t run = 0;
-      char run_op = 0;
-      for (auto it = rev_ops.rbegin(); it != rev_ops.rend(); ++it) {
-        if (*it == run_op) {
-          ++run;
-        } else {
-          push_op(cigar, run_op, run);
-          run_op = *it;
-          run = 1;
-        }
-      }
-      push_op(cigar, run_op, run);
-      return cigar;
+      return cigar_from_reversed_ops(rev_ops);
     }
     k *= 2;
   }
 }
+
+// Banded block-Myers (Hyyro) with per-column VP/VN snapshots and a
+// popcount-based traceback. Band half-width k = dist + 65: the optimal path
+// deviates at most `dist` diagonals from the endpoint diagonals, so it stays
+// a full block away from the band edge, where the +1 boundary approximation
+// (an overestimate, hence never winning a min) lives.
+std::string myers_banded_cigar(const char* q, uint32_t n, const char* t,
+                               uint32_t m, int64_t dist) {
+  const int64_t k = dist + 65;
+  const int64_t diff = static_cast<int64_t>(m) - static_cast<int64_t>(n);
+  const int64_t dmin = std::min<int64_t>(0, diff) - k;
+  const int64_t dmax = std::max<int64_t>(0, diff) + k;
+  const uint32_t W = (n + 63) / 64;
+
+  // Block range per column j (1-based): rows i in [max(1, j-dmax),
+  // min(n, j-dmin)], bit r = i-1.
+  auto blo = [&](int64_t j) -> int64_t {
+    const int64_t top = std::max<int64_t>(1, j - dmax);
+    return (top - 1) / 64;
+  };
+  auto bhi = [&](int64_t j) -> int64_t {
+    const int64_t bot = std::min<int64_t>(n, j - dmin);
+    return (bot - 1) / 64;
+  };
+
+  std::vector<uint64_t> peq(static_cast<size_t>(W) * 256, 0);
+  for (uint32_t i = 0; i < n; ++i) {
+    peq[static_cast<size_t>(i / 64) * 256 + static_cast<uint8_t>(q[i])] |=
+        1ull << (i % 64);
+  }
+
+  std::vector<uint64_t> vp(W, ~0ull), vn(W, 0);
+
+  // Per-column snapshot storage.
+  std::vector<size_t> col_off(m + 1, 0);
+  std::vector<int32_t> col_blo(m + 1, 0), col_bhi(m + 1, -1);
+  std::vector<int64_t> col_bot(m + 1, 0);  // score at row (bhi+1)*64 (virtual)
+  size_t total_blocks = 0;
+  for (int64_t j = 1; j <= m; ++j) {
+    total_blocks += static_cast<size_t>(bhi(j) - blo(j) + 1);
+  }
+  if (total_blocks * 16 > (3ull << 30)) {
+    return std::string();  // too big; caller falls back
+  }
+  std::vector<uint64_t> svp(total_blocks), svn(total_blocks);
+
+  // Column 0 snapshot is implicit: D[i][0] = i.
+  int64_t bot_score = 64ll * (bhi(1) + 1);  // virtual bottom of col 0 band
+  size_t off = 0;
+  int64_t prev_bhi = bhi(1);
+  // initialize bands below: vp preinitialized ~0 handles fresh blocks
+
+  for (int64_t j = 1; j <= m; ++j) {
+    const int64_t lo_b = blo(j), hi_b = bhi(j);
+    // Entering new bottom blocks: extend the bottom anchor (fresh blocks are
+    // all-VP, +1 per row).
+    if (hi_b > prev_bhi) {
+      bot_score += 64ll * (hi_b - prev_bhi);
+      prev_bhi = hi_b;
+    }
+
+    const uint8_t c = static_cast<uint8_t>(t[j - 1]);
+    int hin = 1;  // top boundary (row 0 or band top) advances +1 per column
+    for (int64_t b = lo_b; b <= hi_b; ++b) {
+      hin = myers_block_step(peq[static_cast<size_t>(b) * 256 + c], vp[b],
+                             vn[b], hin);
+    }
+    bot_score += hin;
+
+    col_off[j] = off;
+    col_blo[j] = static_cast<int32_t>(lo_b);
+    col_bhi[j] = static_cast<int32_t>(hi_b);
+    col_bot[j] = bot_score;
+    for (int64_t b = lo_b; b <= hi_b; ++b) {
+      svp[off] = vp[b];
+      svn[off] = vn[b];
+      ++off;
+    }
+  }
+
+  // D(i, j) from the column-j snapshot: walk up from the bottom anchor.
+  auto cell = [&](int64_t i, int64_t j) -> int64_t {
+    if (j == 0) {
+      return i;
+    }
+    if (i == 0) {
+      return j;
+    }
+    const int64_t lo_b = col_blo[j], hi_b = col_bhi[j];
+    int64_t score = col_bot[j];
+    // rows (r+1) for bits r; peel rows strictly above the anchor down to i.
+    for (int64_t b = hi_b; b >= lo_b; --b) {
+      const int64_t base = b * 64;  // bit r covers row r+1
+      if (base + 1 > i) {
+        // whole block rows are > i: peel all 64
+        const uint64_t p = svp[col_off[j] + (b - lo_b)];
+        const uint64_t mn = svn[col_off[j] + (b - lo_b)];
+        score -= __builtin_popcountll(p);
+        score += __builtin_popcountll(mn);
+      } else {
+        // partial: peel rows i+1 .. base+64 -> bits (i-base) .. 63
+        const int shift = static_cast<int>(i - base);
+        const uint64_t mask = shift >= 64 ? 0 : (~0ull << shift);
+        const uint64_t p = svp[col_off[j] + (b - lo_b)] & mask;
+        const uint64_t mn = svn[col_off[j] + (b - lo_b)] & mask;
+        score -= __builtin_popcountll(p);
+        score += __builtin_popcountll(mn);
+        break;
+      }
+    }
+    return score;
+  };
+
+  if (cell(n, m) != dist) {
+    return std::string();  // boundary approximation violated; fall back
+  }
+
+  std::string rev_ops;
+  rev_ops.reserve(n + m);
+  int64_t i = n, j = m;
+  int64_t cur = dist;  // cell(n, m), carried forward between steps
+  while (i > 0 || j > 0) {
+    int64_t next;
+    if (i > 0 && j > 0 &&
+        (next = cell(i - 1, j - 1)) + (q[i - 1] == t[j - 1] ? 0 : 1) == cur) {
+      rev_ops += 'M';
+      --i;
+      --j;
+    } else if (j > 0 && (next = cell(i, j - 1)) + 1 == cur) {
+      rev_ops += 'D';
+      --j;
+    } else {
+      next = cur - 1;  // vertical move always costs 1
+      rev_ops += 'I';
+      --i;
+    }
+    cur = next;
+  }
+
+  return cigar_from_reversed_ops(rev_ops);
+}
+
+}  // namespace
 
 // Myers/Hyyro bit-parallel global edit distance over 64-row blocks.
 int64_t edit_distance(const char* q, uint32_t q_len, const char* t,
@@ -188,37 +392,13 @@ int64_t edit_distance(const char* q, uint32_t q_len, const char* t,
   // never match, which keeps the recurrence exact for row q_len).
   int64_t score = 64ll * W;
 
-  constexpr uint64_t kHigh = 1ull << 63;
 
   for (uint32_t j = 0; j < t_len; ++j) {
     const uint8_t c = static_cast<uint8_t>(t[j]);
     int hin = 1;  // top boundary D[0][j] = j increments every column
     for (uint32_t b = 0; b < W; ++b) {
-      uint64_t eq = peq[static_cast<size_t>(b) * 256 + c];
-      const uint64_t pv = vp[b], mv = vn[b];
-      const uint64_t xv = eq | mv;
-      if (hin < 0) {
-        eq |= 1;
-      }
-      const uint64_t xh = (((eq & pv) + pv) ^ pv) | eq;
-      uint64_t ph = mv | ~(xh | pv);
-      uint64_t mh = pv & xh;
-      int hout = 0;
-      if (ph & kHigh) {
-        hout = 1;
-      } else if (mh & kHigh) {
-        hout = -1;
-      }
-      ph <<= 1;
-      mh <<= 1;
-      if (hin < 0) {
-        mh |= 1;
-      } else if (hin > 0) {
-        ph |= 1;
-      }
-      vp[b] = mh | ~(xv | ph);
-      vn[b] = ph & xv;
-      hin = hout;
+      hin = myers_block_step(peq[static_cast<size_t>(b) * 256 + c], vp[b],
+                             vn[b], hin);
     }
     score += hin;
   }
