@@ -13,12 +13,26 @@ axis: accelerated backend vs its CPU SPOA path).
 """
 
 import json
+import subprocess
 import sys
 import time
 
 D = "/root/reference/test/data/"
 ARGS = dict(window_length=500, quality_threshold=10.0, error_threshold=0.3,
             match=5, mismatch=-4, gap=-8, num_threads=1)
+
+
+def device_healthy(timeout_s: int = 120) -> bool:
+    """The axon TPU tunnel can wedge (device ops then hang forever); probe
+    it in a subprocess so a dead tunnel can't hang the benchmark."""
+    probe = ("import jax, jax.numpy as jnp; "
+             "x = jnp.ones((128, 128)); print(float((x @ x).sum()))")
+    try:
+        r = subprocess.run([sys.executable, "-c", probe],
+                           capture_output=True, timeout=timeout_s)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
 
 def run(backend: str):
@@ -36,6 +50,17 @@ def run(backend: str):
 
 
 def main():
+    if not device_healthy():
+        # Dead tunnel: measure the device *code path* on the CPU backend so
+        # the benchmark still completes (flagged in the metric name).
+        print("[bench] WARNING: TPU device unreachable; running the device "
+              "path on the CPU backend", file=sys.stderr)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        suffix = " [TPU UNREACHABLE: device path on CPU backend]"
+    else:
+        suffix = ""
+
     # Warm the device path once so compile time is not billed as throughput
     # (compiled kernels are cached for the steady-state measurement).
     run("tpu")
@@ -46,7 +71,7 @@ def main():
     mbps_cpu = bp_cpu / dt_cpu / 1e6
     print(json.dumps({
         "metric": "polished Mbp/sec (lambda 47.5kb, PAF+qual, w=500, "
-                  "end-to-end)",
+                  "end-to-end)" + suffix,
         "value": round(mbps_tpu, 4),
         "unit": "Mbp/s",
         "vs_baseline": round(mbps_tpu / mbps_cpu, 3),

@@ -26,7 +26,14 @@ import gzip  # noqa: E402
 
 import pytest  # noqa: E402
 
-DATA = "/root/reference/test/data/"
+# The reference lambda-phage dataset; override for CI environments without
+# the reference checkout.
+DATA = os.environ.get("RACON_TPU_TEST_DATA", "/root/reference/test/data/")
+
+requires_data = pytest.mark.skipif(
+    not os.path.isdir(DATA),
+    reason=f"lambda test data not found at {DATA} "
+           "(set RACON_TPU_TEST_DATA)")
 
 _COMP = bytes.maketrans(b"ACGT", b"TGCA")
 

@@ -1,0 +1,59 @@
+"""Input-validation death tests — the reference's EXPECT_DEATH strategy
+(/root/reference/test/racon_test.cpp:53-84) via subprocess exit codes."""
+
+import os
+import subprocess
+import sys
+
+from tests.conftest import DATA, requires_data
+
+BIN = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "racon_tpu", "native", "build", "racon_tpu")
+
+
+pytestmark = requires_data
+
+def run_bin(*args):
+    return subprocess.run([BIN, *args], capture_output=True, text=True,
+                          timeout=120)
+
+
+def test_window_length_error():
+    r = run_bin("-w", "0", DATA + "sample_reads.fastq.gz",
+                DATA + "sample_overlaps.paf.gz",
+                DATA + "sample_layout.fasta.gz")
+    assert r.returncode == 1
+    assert "invalid window length" in r.stderr
+
+
+def test_sequences_extension_error():
+    r = run_bin("reads.txt", "o.paf", "t.fa")
+    assert r.returncode == 1
+    assert "unsupported format extension" in r.stderr
+    assert ".fasta" in r.stderr
+
+
+def test_overlaps_extension_error():
+    r = run_bin(DATA + "sample_reads.fastq.gz", "o.bed", "t.fa")
+    assert r.returncode == 1
+    assert ".mhap" in r.stderr
+
+
+def test_target_extension_error():
+    r = run_bin(DATA + "sample_reads.fastq.gz",
+                DATA + "sample_overlaps.paf.gz", "t.bed")
+    assert r.returncode == 1
+    assert "unsupported format extension" in r.stderr
+
+
+def test_missing_inputs():
+    r = run_bin()
+    assert r.returncode == 1
+    assert "missing input" in r.stderr
+
+
+def test_missing_file():
+    r = run_bin(DATA + "sample_reads.fastq.gz",
+                DATA + "sample_overlaps.paf.gz", "/nonexistent/x.fasta")
+    assert r.returncode == 1
+    assert "unable to open" in r.stderr

@@ -28,13 +28,15 @@ import pytest
 
 import racon_tpu
 from racon_tpu import native
-from tests.conftest import DATA, revcomp
+from tests.conftest import DATA, revcomp, requires_data
 
 FULL = os.environ.get("RACON_TPU_FULL_GOLDEN") == "1"
 
 ARGS = dict(window_length=500, quality_threshold=10.0, error_threshold=0.3,
             match=5, mismatch=-4, gap=-8, num_threads=1)
 
+
+pytestmark = requires_data
 
 def polish(seqs, ovl, tgt, backend="cpu", drop=True, **kw):
     a = dict(ARGS)

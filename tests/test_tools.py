@@ -9,8 +9,10 @@ import sys
 import pytest
 
 from racon_tpu.tools import preprocess, sampler
-from tests.conftest import DATA, read_fasta_gz
+from tests.conftest import DATA, read_fasta_gz, requires_data
 
+
+pytestmark = requires_data
 
 def _write_fasta(path, records):
     with open(path, "w") as f:
