@@ -152,6 +152,15 @@ def _n_devices() -> int:
     return len(jax.devices())
 
 
+def _fits_vmem(cfg, budget_bytes: int = 12 << 20) -> bool:
+    """Whether the fused Pallas kernel's VMEM scratch fits the core budget."""
+    lp = (cfg.max_len + 1 + 127) // 128 * 128
+    h = (cfg.max_nodes + 1) * lp * 4
+    layers = 2 * cfg.depth * cfg.max_len * 4
+    graph = cfg.max_nodes * (4 * 4 + 2 * cfg.max_edges * 4)
+    return h + layers + graph < budget_bytes
+
+
 def _build_kernel(cfg, B, use_pallas):
     """Single- or multi-device kernel for a B-window batch.
 
@@ -162,6 +171,10 @@ def _build_kernel(cfg, B, use_pallas):
     import jax
 
     n_dev = _n_devices()
+    if use_pallas and not _fits_vmem(cfg):
+        # Large window geometries (e.g. -w 1000) overflow the ~16 MB/core
+        # VMEM budget of the fused kernel; use the XLA-scheduled variant.
+        use_pallas = False
     if use_pallas:
         from . import poa_pallas
         interp = jax.devices()[0].platform != "tpu"
