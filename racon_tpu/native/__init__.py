@@ -65,6 +65,9 @@ def load() -> ctypes.CDLL:
     u32p = ctypes.POINTER(ctypes.c_uint32)
     u64p = ctypes.POINTER(ctypes.c_uint64)
 
+    lib.rt_last_error.restype = ctypes.c_char_p
+    lib.rt_last_error.argtypes = []
+
     lib.rt_edit_distance.restype = ctypes.c_int64
     lib.rt_edit_distance.argtypes = [
         ctypes.c_char_p, ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint32]
@@ -146,6 +149,17 @@ def load() -> ctypes.CDLL:
     return lib
 
 
+class NativeError(RuntimeError):
+    """Raised when the native runtime reports an error (it never exits the
+    process when used as a library; the CLI binary exits 1 instead)."""
+
+
+def check_error(lib: ctypes.CDLL) -> None:
+    msg = lib.rt_last_error()
+    if msg:
+        raise NativeError(msg.decode().strip())
+
+
 def edit_distance(q: bytes, t: bytes) -> int:
     """Global (NW) edit distance — the accuracy metric of the test suite
     (reference analogue: test/racon_test.cpp:14-23)."""
@@ -157,6 +171,9 @@ def align_cigar(q: bytes, t: bytes) -> str:
     """Global alignment CIGAR (host banded NW)."""
     lib = load()
     ptr = lib.rt_align_cigar(q, len(q), t, len(t))
+    if not ptr:
+        check_error(lib)
+        raise NativeError("alignment failed")
     try:
         return ctypes.string_at(ptr).decode()
     finally:
@@ -189,6 +206,9 @@ def window_consensus(backbone: bytes, layers, *, backbone_qual: bytes = None,
         backbone, bb_len, backbone_qual, bases, qual_cat, lens, begins_a,
         ends_a, n, 1 if has_qual else 0, 1 if tgs else 0, 1 if trim else 0,
         match, mismatch, gap, ctypes.byref(polished))
+    if not ptr:
+        check_error(lib)
+        raise NativeError("window consensus failed")
     try:
         return ctypes.string_at(ptr), bool(polished.value)
     finally:

@@ -1,3 +1,4 @@
+#include "rt_error.hpp"
 #include "rt_align.hpp"
 
 #include <algorithm>
@@ -130,11 +131,9 @@ std::string scalar_banded_cigar(const char* q, uint32_t q_len, const char* t,
     const size_t tb_bytes =
         (static_cast<size_t>(q_len + 1) * static_cast<size_t>(width) + 3) / 4;
     if (tb_bytes > (3ull << 30)) {
-      std::fprintf(stderr,
-                   "[racon_tpu::align_global_cigar] error: alignment of "
+      rt::fail("[racon_tpu::align_global_cigar] error: alignment of "
                    "%u x %u exceeds memory budget!\n",
                    q_len, t_len);
-      std::exit(1);
     }
     tb.assign(tb_bytes, 0);
     prev_row.assign(width, kInf);

@@ -5,6 +5,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <memory>
 #include <string>
 #include <vector>
 
@@ -25,9 +26,34 @@ struct PipelineHandle {
   bool stitched = false;
 };
 
+// Errors cross the C ABI as a thread-local message (the Python binding
+// raises after every call that sets it); the CLI binary instead catches
+// rt::Error at main() and exits 1 — the reference's observable behavior.
+thread_local std::string g_error;
+
+template <typename F>
+auto guarded(F&& f, decltype(f()) fallback) -> decltype(f()) {
+  g_error.clear();
+  try {
+    return f();
+  } catch (const std::exception& e) {
+    g_error = e.what();
+    return fallback;
+  }
+}
+
+template <typename F>
+void guarded_void(F&& f) {
+  guarded([&]() -> int { f(); return 0; }, 0);
+}
+
 }  // namespace
 
 extern "C" {
+
+const char* rt_last_error() {
+  return g_error.empty() ? nullptr : g_error.c_str();
+}
 
 // ---------- standalone kernels -------------------------------------------
 
@@ -38,10 +64,12 @@ int64_t rt_edit_distance(const char* q, uint32_t q_len, const char* t,
 
 char* rt_align_cigar(const char* q, uint32_t q_len, const char* t,
                      uint32_t t_len) {
-  const std::string cigar = rt::align_global_cigar(q, q_len, t, t_len);
-  char* out = static_cast<char*>(std::malloc(cigar.size() + 1));
-  std::memcpy(out, cigar.c_str(), cigar.size() + 1);
-  return out;
+  return guarded([&]() -> char* {
+    const std::string cigar = rt::align_global_cigar(q, q_len, t, t_len);
+    char* out = static_cast<char*>(std::malloc(cigar.size() + 1));
+    std::memcpy(out, cigar.c_str(), cigar.size() + 1);
+    return out;
+  }, nullptr);
 }
 
 void rt_free(void* p) { std::free(p); }
@@ -57,26 +85,28 @@ char* rt_window_consensus(const char* backbone, uint32_t backbone_len,
                           uint32_t n_layers, int has_qual, int window_type,
                           int trim, int8_t match, int8_t mismatch, int8_t gap,
                           int* polished) {
-  std::string dummy(backbone_len, '!');
-  auto window = rt::createWindow(
-      0, 0, window_type == 0 ? rt::WindowType::kNGS : rt::WindowType::kTGS,
-      backbone, backbone_len, backbone_qual ? backbone_qual : dummy.data(),
-      backbone_len);
-  uint64_t off = 0;
-  for (uint32_t i = 0; i < n_layers; ++i) {
-    window->add_layer(layer_bases + off, lens[i],
-                      has_qual ? layer_quals + off : nullptr,
-                      has_qual ? lens[i] : 0, begins[i], ends[i]);
-    off += lens[i];
-  }
-  rt::PoaAligner aligner(match, mismatch, gap);
-  const bool p = window->generate_consensus(aligner, trim != 0);
-  if (polished) {
-    *polished = p ? 1 : 0;
-  }
-  char* out = static_cast<char*>(std::malloc(window->consensus.size() + 1));
-  std::memcpy(out, window->consensus.c_str(), window->consensus.size() + 1);
-  return out;
+  return guarded([&]() -> char* {
+    std::string dummy(backbone_len, '!');
+    auto window = rt::createWindow(
+        0, 0, window_type == 0 ? rt::WindowType::kNGS : rt::WindowType::kTGS,
+        backbone, backbone_len, backbone_qual ? backbone_qual : dummy.data(),
+        backbone_len);
+    uint64_t off = 0;
+    for (uint32_t i = 0; i < n_layers; ++i) {
+      window->add_layer(layer_bases + off, lens[i],
+                        has_qual ? layer_quals + off : nullptr,
+                        has_qual ? lens[i] : 0, begins[i], ends[i]);
+      off += lens[i];
+    }
+    rt::PoaAligner aligner(match, mismatch, gap);
+    const bool p = window->generate_consensus(aligner, trim != 0);
+    if (polished) {
+      *polished = p ? 1 : 0;
+    }
+    char* out = static_cast<char*>(std::malloc(window->consensus.size() + 1));
+    std::memcpy(out, window->consensus.c_str(), window->consensus.size() + 1);
+    return out;
+  }, nullptr);
 }
 
 // ---------- pipeline ------------------------------------------------------
@@ -86,20 +116,22 @@ void* rt_pipeline_create(const char* sequences_path, const char* overlaps_path,
                          uint32_t window_length, double quality_threshold,
                          double error_threshold, int trim, int8_t match,
                          int8_t mismatch, int8_t gap, uint32_t num_threads) {
-  PipelineParams params;
-  params.type = type;
-  params.window_length = window_length;
-  params.quality_threshold = quality_threshold;
-  params.error_threshold = error_threshold;
-  params.trim = trim != 0;
-  params.match = match;
-  params.mismatch = mismatch;
-  params.gap = gap;
-  params.num_threads = num_threads;
-  auto* h = new PipelineHandle();
-  h->pipeline.reset(
-      new Pipeline(sequences_path, overlaps_path, target_path, params));
-  return h;
+  return guarded([&]() -> void* {
+    PipelineParams params;
+    params.type = type;
+    params.window_length = window_length;
+    params.quality_threshold = quality_threshold;
+    params.error_threshold = error_threshold;
+    params.trim = trim != 0;
+    params.match = match;
+    params.mismatch = mismatch;
+    params.gap = gap;
+    params.num_threads = num_threads;
+    auto h = std::make_unique<PipelineHandle>();
+    h->pipeline.reset(
+        new Pipeline(sequences_path, overlaps_path, target_path, params));
+    return h.release();
+  }, nullptr);
 }
 
 void rt_pipeline_destroy(void* handle) {
@@ -107,7 +139,8 @@ void rt_pipeline_destroy(void* handle) {
 }
 
 void rt_pipeline_prepare(void* handle) {
-  static_cast<PipelineHandle*>(handle)->pipeline->prepare();
+  guarded_void(
+      [&] { static_cast<PipelineHandle*>(handle)->pipeline->prepare(); });
 }
 
 uint64_t rt_pipeline_num_align_jobs(void* handle) {
@@ -117,24 +150,34 @@ uint64_t rt_pipeline_num_align_jobs(void* handle) {
 // Query/target views for alignment job k (zero-copy pointers + lengths).
 void rt_pipeline_align_job(void* handle, uint64_t job, const char** q,
                            uint32_t* q_len, const char** t, uint32_t* t_len) {
-  static_cast<PipelineHandle*>(handle)->pipeline->align_job_views(job, q, q_len,
-                                                                  t, t_len);
+  guarded_void([&] {
+    static_cast<PipelineHandle*>(handle)->pipeline->align_job_views(
+        job, q, q_len, t, t_len);
+  });
 }
 
 void rt_pipeline_set_job_cigar(void* handle, uint64_t job, const char* cigar) {
-  static_cast<PipelineHandle*>(handle)->pipeline->set_job_cigar(job, cigar);
+  guarded_void([&] {
+    static_cast<PipelineHandle*>(handle)->pipeline->set_job_cigar(job, cigar);
+  });
 }
 
 void rt_pipeline_align_jobs_cpu(void* handle) {
-  static_cast<PipelineHandle*>(handle)->pipeline->align_jobs_cpu();
+  guarded_void([&] {
+    static_cast<PipelineHandle*>(handle)->pipeline->align_jobs_cpu();
+  });
 }
 
 void rt_pipeline_build_windows(void* handle) {
-  static_cast<PipelineHandle*>(handle)->pipeline->build_windows();
+  guarded_void([&] {
+    static_cast<PipelineHandle*>(handle)->pipeline->build_windows();
+  });
 }
 
 void rt_pipeline_initialize(void* handle) {
-  static_cast<PipelineHandle*>(handle)->pipeline->initialize();
+  guarded_void([&] {
+    static_cast<PipelineHandle*>(handle)->pipeline->initialize();
+  });
 }
 
 uint64_t rt_pipeline_num_windows(void* handle) {
@@ -144,6 +187,7 @@ uint64_t rt_pipeline_num_windows(void* handle) {
 // Window metadata: [n_total_seqs (incl. backbone), backbone_len, rank, type,
 // total_layer_bytes, target_id]
 void rt_pipeline_window_info(void* handle, uint64_t i, uint64_t* out6) {
+  guarded_void([&] {
   const auto& w = static_cast<PipelineHandle*>(handle)->pipeline->window(i);
   out6[0] = w.sequences.size();
   out6[1] = w.sequences.front().second;
@@ -155,6 +199,7 @@ void rt_pipeline_window_info(void* handle, uint64_t i, uint64_t* out6) {
   }
   out6[4] = total;
   out6[5] = w.id;
+  });
 }
 
 // Export a window's backbone and layers, layers stably sorted by begin
@@ -165,6 +210,7 @@ void rt_pipeline_window_export(void* handle, uint64_t i, uint8_t* bb_bases,
                                uint8_t* bb_weights, uint32_t* lens,
                                uint32_t* begins, uint32_t* ends,
                                uint8_t* bases_concat, uint8_t* weights_concat) {
+  guarded_void([&] {
   const auto& w = static_cast<PipelineHandle*>(handle)->pipeline->window(i);
   const uint32_t bl = w.sequences.front().second;
   std::memcpy(bb_bases, w.sequences.front().first, bl);
@@ -199,31 +245,45 @@ void rt_pipeline_window_export(void* handle, uint64_t i, uint8_t* bb_bases,
     }
     off += len;
   }
+  });
 }
 
 int rt_pipeline_consensus_cpu_one(void* handle, uint64_t i) {
-  return static_cast<PipelineHandle*>(handle)->pipeline->consensus_cpu_one(i)
-             ? 1
-             : 0;
+  return guarded(
+      [&]() -> int {
+        return static_cast<PipelineHandle*>(handle)
+                       ->pipeline->consensus_cpu_one(i)
+                   ? 1
+                   : 0;
+      },
+      -1);
 }
 
 void rt_pipeline_consensus_cpu_all(void* handle) {
-  static_cast<PipelineHandle*>(handle)->pipeline->consensus_cpu_all();
+  guarded_void([&] {
+    static_cast<PipelineHandle*>(handle)->pipeline->consensus_cpu_all();
+  });
 }
 
 void rt_pipeline_set_consensus(void* handle, uint64_t i, const char* consensus,
                                uint32_t len, int polished) {
-  static_cast<PipelineHandle*>(handle)->pipeline->set_consensus(
-      i, std::string(consensus, len), polished != 0);
+  guarded_void([&] {
+    static_cast<PipelineHandle*>(handle)->pipeline->set_consensus(
+        i, std::string(consensus, len), polished != 0);
+  });
 }
 
 uint64_t rt_pipeline_stitch(void* handle, int drop_unpolished) {
-  auto* h = static_cast<PipelineHandle*>(handle);
-  if (!h->stitched) {  // idempotent: repeat calls return the cached results
-    h->pipeline->stitch(drop_unpolished != 0, &h->results);
-    h->stitched = true;
-  }
-  return h->results.size();
+  return guarded(
+      [&]() -> uint64_t {
+        auto* h = static_cast<PipelineHandle*>(handle);
+        if (!h->stitched) {  // idempotent: repeats return cached results
+          h->pipeline->stitch(drop_unpolished != 0, &h->results);
+          h->stitched = true;
+        }
+        return h->results.size();
+      },
+      static_cast<uint64_t>(-1));
 }
 
 const char* rt_pipeline_result_name(void* handle, uint64_t i, uint64_t* len) {

@@ -4,6 +4,8 @@
 // which shares this same native pipeline through the C ABI.
 #include <getopt.h>
 
+#include <exception>
+
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -96,14 +98,19 @@ int main(int argc, char** argv) {
     return 1;
   }
 
-  rt::Pipeline pipeline(inputs[0], inputs[1], inputs[2], params);
-  pipeline.initialize();
-  pipeline.consensus_cpu_all();
+  try {
+    rt::Pipeline pipeline(inputs[0], inputs[1], inputs[2], params);
+    pipeline.initialize();
+    pipeline.consensus_cpu_all();
 
-  std::vector<std::pair<std::string, std::string>> dst;
-  pipeline.stitch(drop_unpolished, &dst);
-  for (const auto& it : dst) {
-    std::fprintf(stdout, ">%s\n%s\n", it.first.c_str(), it.second.c_str());
+    std::vector<std::pair<std::string, std::string>> dst;
+    pipeline.stitch(drop_unpolished, &dst);
+    for (const auto& it : dst) {
+      std::fprintf(stdout, ">%s\n%s\n", it.first.c_str(), it.second.c_str());
+    }
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "%s", e.what());
+    return 1;
   }
   return 0;
 }

@@ -1,3 +1,4 @@
+#include "rt_error.hpp"
 #include "rt_overlap.hpp"
 
 #include <algorithm>
@@ -72,10 +73,8 @@ std::unique_ptr<Overlap> Overlap::from_sam(std::string q_name, uint32_t flag,
   // Unmapped records are dropped later; mapped records must carry a real
   // alignment (parity: src/overlap.cpp:55-59).
   if (o->cigar.size() < 2 && o->is_valid) {
-    std::fprintf(stderr,
-                 "[racon_tpu::Overlap::from_sam] error: "
+    rt::fail("[racon_tpu::Overlap::from_sam] error: "
                  "missing alignment from SAM object!\n");
-    std::exit(1);
   }
 
   // Leading clip gives the query start; M/=/X/I/D/N tally the aligned and
@@ -157,11 +156,9 @@ void Overlap::transmute(
   }
 
   if (q_length != sequences[q_id]->data.size()) {
-    std::fprintf(stderr,
-                 "[racon_tpu::Overlap::transmute] error: unequal lengths in "
+    rt::fail("[racon_tpu::Overlap::transmute] error: unequal lengths in "
                  "sequence and overlap file for sequence %s!\n",
                  sequences[q_id]->name.c_str());
-    std::exit(1);
   }
 
   if (!t_name.empty()) {
@@ -176,11 +173,9 @@ void Overlap::transmute(
   }
 
   if (t_length != 0 && t_length != sequences[t_id]->data.size()) {
-    std::fprintf(stderr,
-                 "[racon_tpu::Overlap::transmute] error: unequal lengths in "
+    rt::fail("[racon_tpu::Overlap::transmute] error: unequal lengths in "
                  "target and overlap file for target %s!\n",
                  sequences[t_id]->name.c_str());
-    std::exit(1);
   }
   t_length = sequences[t_id]->data.size();  // SAM carries no target length
 
@@ -206,10 +201,8 @@ void Overlap::find_breaking_points(
     const std::vector<std::unique_ptr<Sequence>>& sequences,
     uint32_t window_length) {
   if (!is_transmuted) {
-    std::fprintf(stderr,
-                 "[racon_tpu::Overlap::find_breaking_points] error: overlap "
+    rt::fail("[racon_tpu::Overlap::find_breaking_points] error: overlap "
                  "is not transmuted!\n");
-    std::exit(1);
   }
   if (!breaking_points.empty()) {
     return;

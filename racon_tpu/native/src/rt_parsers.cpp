@@ -1,3 +1,4 @@
+#include "rt_error.hpp"
 #include "rt_parsers.hpp"
 
 #include <zlib.h>
@@ -11,9 +12,8 @@ namespace rt {
 GzReader::GzReader(const std::string& path) : path_(path), buf_(1 << 20) {
   file_ = gzopen(path.c_str(), "rb");
   if (file_ == nullptr) {
-    std::fprintf(stderr, "[racon_tpu::GzReader] error: unable to open file %s!\n",
+    rt::fail("[racon_tpu::GzReader] error: unable to open file %s!\n",
                  path.c_str());
-    std::exit(1);
   }
   gzbuffer(static_cast<gzFile>(file_), 1 << 20);
 }
@@ -37,9 +37,8 @@ void GzReader::fill() {
   const int n =
       gzread(static_cast<gzFile>(file_), buf_.data(), static_cast<unsigned>(buf_.size()));
   if (n < 0) {
-    std::fprintf(stderr, "[racon_tpu::GzReader] error: failed reading %s!\n",
+    rt::fail("[racon_tpu::GzReader] error: failed reading %s!\n",
                  path_.c_str());
-    std::exit(1);
   }
   pos_ = 0;
   len_ = static_cast<size_t>(n);
@@ -192,10 +191,8 @@ bool SequenceParser::parse_one(std::vector<std::unique_ptr<Sequence>>& dst,
     qual += line;
   }
   if (qual.size() != data.size()) {
-    std::fprintf(stderr,
-                 "[racon_tpu::SequenceParser] error: malformed FASTQ record "
+    rt::fail("[racon_tpu::SequenceParser] error: malformed FASTQ record "
                  "(quality length mismatch)!\n");
-    std::exit(1);
   }
   size_t name_end = header.find_first_of(" \t", 1);
   if (name_end == std::string::npos) {
@@ -273,9 +270,7 @@ std::vector<std::unique_ptr<Overlap>> OverlapParser::parse(uint64_t max_bytes) {
       //       B-rc B-begin B-end B-len (space or tab separated)
       auto f = split_spaces(line);
       if (f.size() < 12) {
-        std::fprintf(stderr,
-                     "[racon_tpu::OverlapParser] error: malformed MHAP line!\n");
-        std::exit(1);
+        rt::fail("[racon_tpu::OverlapParser] error: malformed MHAP line!\n");
       }
       dst.push_back(Overlap::from_mhap(
           std::strtoull(f[0].c_str(), nullptr, 10),
@@ -292,9 +287,7 @@ std::vector<std::unique_ptr<Overlap>> OverlapParser::parse(uint64_t max_bytes) {
     } else if (fmt_ == OvlFormat::kPaf) {
       auto f = split_tabs(line);
       if (f.size() < 9) {
-        std::fprintf(stderr,
-                     "[racon_tpu::OverlapParser] error: malformed PAF line!\n");
-        std::exit(1);
+        rt::fail("[racon_tpu::OverlapParser] error: malformed PAF line!\n");
       }
       dst.push_back(Overlap::from_paf(
           f[0], static_cast<uint32_t>(std::strtoul(f[1].c_str(), nullptr, 10)),
@@ -310,9 +303,7 @@ std::vector<std::unique_ptr<Overlap>> OverlapParser::parse(uint64_t max_bytes) {
       }
       auto f = split_tabs(line);
       if (f.size() < 11) {
-        std::fprintf(stderr,
-                     "[racon_tpu::OverlapParser] error: malformed SAM line!\n");
-        std::exit(1);
+        rt::fail("[racon_tpu::OverlapParser] error: malformed SAM line!\n");
       }
       dst.push_back(Overlap::from_sam(
           f[0], static_cast<uint32_t>(std::strtoul(f[1].c_str(), nullptr, 10)),

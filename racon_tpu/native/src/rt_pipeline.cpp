@@ -1,3 +1,4 @@
+#include "rt_error.hpp"
 #include "rt_pipeline.hpp"
 
 #include "rt_align.hpp"
@@ -20,43 +21,33 @@ Pipeline::Pipeline(const std::string& sequences_path,
                    const PipelineParams& params)
     : params_(params) {
   if (params_.type != 0 && params_.type != 1) {
-    std::fprintf(stderr,
-                 "[racon_tpu::createPolisher] error: invalid polisher type!\n");
-    std::exit(1);
+    rt::fail("[racon_tpu::createPolisher] error: invalid polisher type!\n");
   }
   if (params_.window_length == 0) {
-    std::fprintf(stderr,
-                 "[racon_tpu::createPolisher] error: invalid window length!\n");
-    std::exit(1);
+    rt::fail("[racon_tpu::createPolisher] error: invalid window length!\n");
   }
 
   SeqFormat sfmt, tfmt;
   OvlFormat ofmt;
   if (!sniff_sequence_format(sequences_path, &sfmt)) {
-    std::fprintf(stderr,
-                 "[racon_tpu::createPolisher] error: file %s has unsupported "
+    rt::fail("[racon_tpu::createPolisher] error: file %s has unsupported "
                  "format extension (valid extensions: .fasta, .fasta.gz, "
                  ".fna, .fna.gz, .fa, .fa.gz, .fastq, .fastq.gz, .fq, "
                  ".fq.gz)!\n",
                  sequences_path.c_str());
-    std::exit(1);
   }
   if (!sniff_overlap_format(overlaps_path, &ofmt)) {
-    std::fprintf(stderr,
-                 "[racon_tpu::createPolisher] error: file %s has unsupported "
+    rt::fail("[racon_tpu::createPolisher] error: file %s has unsupported "
                  "format extension (valid extensions: .mhap, .mhap.gz, .paf, "
                  ".paf.gz, .sam, .sam.gz)!\n",
                  overlaps_path.c_str());
-    std::exit(1);
   }
   if (!sniff_sequence_format(target_path, &tfmt)) {
-    std::fprintf(stderr,
-                 "[racon_tpu::createPolisher] error: file %s has unsupported "
+    rt::fail("[racon_tpu::createPolisher] error: file %s has unsupported "
                  "format extension (valid extensions: .fasta, .fasta.gz, "
                  ".fna, .fna.gz, .fa, .fa.gz, .fastq, .fastq.gz, .fq, "
                  ".fq.gz)!\n",
                  target_path.c_str());
-    std::exit(1);
   }
 
   sparser_.reset(new SequenceParser(sequences_path, sfmt));
@@ -105,6 +96,8 @@ void Pipeline::remove_invalid_overlaps(
 
 void Pipeline::prepare() {
   if (!windows_.empty() || !sequences_.empty()) {
+    // Benign (parity: src/polisher.cpp:192-196): repeat initialization is a
+    // warning, not an error.
     std::fprintf(stderr,
                  "[racon_tpu::Pipeline::prepare] warning: already "
                  "initialized!\n");
@@ -115,10 +108,9 @@ void Pipeline::prepare() {
   sequences_ = tparser_->parse(0);
   targets_size_ = sequences_.size();
   if (targets_size_ == 0) {
-    std::fprintf(stderr,
-                 "[racon_tpu::Pipeline::initialize] error: empty target "
-                 "sequences set!\n");
-    std::exit(1);
+    rt::fail(
+        "[racon_tpu::Pipeline::initialize] error: empty target "
+        "sequences set!\n");
   }
 
   std::unordered_map<std::string, uint64_t> name_to_id;
@@ -147,11 +139,9 @@ void Pipeline::prepare() {
       if (it != name_to_id.end()) {
         if (read->data.size() != sequences_[it->second]->data.size() ||
             read->quality.size() != sequences_[it->second]->quality.size()) {
-          std::fprintf(stderr,
-                       "[racon_tpu::Pipeline::initialize] error: duplicate "
+          rt::fail("[racon_tpu::Pipeline::initialize] error: duplicate "
                        "sequence %s with unequal data\n",
                        read->name.c_str());
-          std::exit(1);
         }
         name_to_id[read->name + "q"] = it->second;
         id_to_id[read_ordinal << 1 | 0] = it->second;
@@ -165,10 +155,8 @@ void Pipeline::prepare() {
     }
   }
   if (read_ordinal == 0) {
-    std::fprintf(stderr,
-                 "[racon_tpu::Pipeline::initialize] error: empty sequences "
+    rt::fail("[racon_tpu::Pipeline::initialize] error: empty sequences "
                  "set!\n");
-    std::exit(1);
   }
 
   has_name.resize(sequences_.size(), false);
@@ -226,10 +214,8 @@ void Pipeline::prepare() {
   }
 
   if (overlaps_.empty()) {
-    std::fprintf(stderr,
-                 "[racon_tpu::Pipeline::initialize] error: empty overlap "
+    rt::fail("[racon_tpu::Pipeline::initialize] error: empty overlap "
                  "set!\n");
-    std::exit(1);
   }
 
   for (const auto& o : overlaps_) {
@@ -253,7 +239,7 @@ void Pipeline::prepare() {
       }));
     }
     for (auto& f : futs) {
-      f.wait();
+      f.get();
     }
   }
 
@@ -293,7 +279,7 @@ void Pipeline::align_jobs_cpu() {
   // (parity: src/polisher.cpp:476-487).
   const size_t step = futs.size() / 20;
   for (size_t i = 0; i < futs.size(); ++i) {
-    futs[i].wait();
+    futs[i].get();
     if (step != 0 && (i + 1) % step == 0 && (i + 1) / step < 20) {
       logger_.bar("[racon_tpu::Pipeline::initialize] aligning overlaps");
     }
@@ -317,7 +303,7 @@ void Pipeline::build_windows() {
       }));
     }
     for (auto& f : futs) {
-      f.wait();
+      f.get();
     }
   }
 
@@ -425,7 +411,7 @@ void Pipeline::consensus_cpu_all() {
   }
   const size_t step = futs.size() / 20;
   for (size_t i = 0; i < futs.size(); ++i) {
-    futs[i].wait();
+    futs[i].get();
     if (step != 0 && (i + 1) % step == 0 && (i + 1) / step < 20) {
       logger_.bar("[racon_tpu::Pipeline::polish] generating consensus");
     }
@@ -446,10 +432,8 @@ void Pipeline::set_consensus(size_t i, std::string consensus, bool polished) {
 void Pipeline::stitch(bool drop_unpolished_sequences,
                       std::vector<std::pair<std::string, std::string>>* dst) {
   if (stitched_) {
-    std::fprintf(stderr,
-                 "[racon_tpu::Pipeline::stitch] error: windows already "
+    rt::fail("[racon_tpu::Pipeline::stitch] error: windows already "
                  "consumed by a previous stitch!\n");
-    std::exit(1);
   }
   stitched_ = true;
 
@@ -458,11 +442,9 @@ void Pipeline::stitch(bool drop_unpolished_sequences,
 
   for (size_t i = 0; i < windows_.size(); ++i) {
     if (!done_[i]) {
-      std::fprintf(stderr,
-                   "[racon_tpu::Pipeline::stitch] error: window %zu has no "
+      rt::fail("[racon_tpu::Pipeline::stitch] error: window %zu has no "
                    "consensus!\n",
                    i);
-      std::exit(1);
     }
     num_polished_windows += polished_[i] ? 1 : 0;
     polished_data += windows_[i]->consensus;

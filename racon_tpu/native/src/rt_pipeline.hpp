@@ -117,9 +117,12 @@ class Pipeline {
   std::vector<uint8_t> polished_;  // POA actually ran
   std::vector<uint64_t> targets_coverages_;
 
-  std::unique_ptr<ThreadPool> pool_;
   std::vector<std::unique_ptr<PoaAligner>> aligners_;  // one per thread
   Logger logger_;
+  // Declared last: destroyed first, so an exception-abandoned task queue
+  // drains (and its tasks' member references stay valid) before any other
+  // member is torn down.
+  std::unique_ptr<ThreadPool> pool_;
 };
 
 }  // namespace rt

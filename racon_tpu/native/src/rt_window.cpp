@@ -1,3 +1,4 @@
+#include "rt_error.hpp"
 #include "rt_window.hpp"
 
 #include <algorithm>
@@ -14,10 +15,8 @@ std::shared_ptr<Window> createWindow(uint64_t id, uint32_t rank,
                                      const char* quality,
                                      uint32_t quality_length) {
   if (backbone_length == 0 || backbone_length != quality_length) {
-    std::fprintf(stderr,
-                 "[racon_tpu::createWindow] error: "
+    rt::fail("[racon_tpu::createWindow] error: "
                  "empty backbone sequence/unequal quality length!\n");
-    std::exit(1);
   }
   return std::make_shared<Window>(id, rank, type, backbone, backbone_length,
                                   quality, quality_length);
@@ -39,17 +38,13 @@ void Window::add_layer(const char* sequence, uint32_t sequence_length,
     return;
   }
   if (quality != nullptr && sequence_length != quality_length) {
-    std::fprintf(stderr,
-                 "[racon_tpu::Window::add_layer] error: "
+    rt::fail("[racon_tpu::Window::add_layer] error: "
                  "unequal quality size!\n");
-    std::exit(1);
   }
   if (begin >= end || begin > sequences.front().second ||
       end > sequences.front().second) {
-    std::fprintf(stderr,
-                 "[racon_tpu::Window::add_layer] error: "
+    rt::fail("[racon_tpu::Window::add_layer] error: "
                  "layer begin and end positions are invalid!\n");
-    std::exit(1);
   }
   sequences.emplace_back(sequence, sequence_length);
   qualities.emplace_back(quality, quality_length);

@@ -49,6 +49,9 @@ class Pipeline:
             target_path.encode(), 1 if fragment_correction else 0,
             window_length, quality_threshold, error_threshold,
             1 if trim else 0, match, mismatch, gap, num_threads)
+        if not self._h:
+            native.check_error(self._lib)
+            raise native.NativeError("pipeline creation failed")
 
     def __del__(self):
         if getattr(self, "_h", None):
@@ -58,6 +61,7 @@ class Pipeline:
     # -- phase 1 ----------------------------------------------------------
     def prepare(self) -> None:
         self._lib.rt_pipeline_prepare(self._h)
+        native.check_error(self._lib)
 
     def num_align_jobs(self) -> int:
         return self._lib.rt_pipeline_num_align_jobs(self._h)
@@ -96,12 +100,15 @@ class Pipeline:
 
     def align_jobs_cpu(self) -> None:
         self._lib.rt_pipeline_align_jobs_cpu(self._h)
+        native.check_error(self._lib)
 
     def build_windows(self) -> None:
         self._lib.rt_pipeline_build_windows(self._h)
+        native.check_error(self._lib)
 
     def initialize(self) -> None:
         self._lib.rt_pipeline_initialize(self._h)
+        native.check_error(self._lib)
 
     # -- phase 2 ----------------------------------------------------------
     def num_windows(self) -> int:
@@ -137,10 +144,15 @@ class Pipeline:
                             weights=weights)
 
     def consensus_cpu_one(self, i: int) -> bool:
-        return bool(self._lib.rt_pipeline_consensus_cpu_one(self._h, i))
+        r = self._lib.rt_pipeline_consensus_cpu_one(self._h, i)
+        if r < 0:
+            native.check_error(self._lib)
+            raise native.NativeError(f"consensus failed for window {i}")
+        return bool(r)
 
     def consensus_cpu_all(self) -> None:
         self._lib.rt_pipeline_consensus_cpu_all(self._h)
+        native.check_error(self._lib)
 
     def set_consensus(self, i: int, consensus: bytes, polished: bool) -> None:
         self._lib.rt_pipeline_set_consensus(
@@ -148,6 +160,7 @@ class Pipeline:
 
     def stitch(self, drop_unpolished: bool = True) -> List[Tuple[str, str]]:
         n = self._lib.rt_pipeline_stitch(self._h, 1 if drop_unpolished else 0)
+        native.check_error(self._lib)
         out = []
         ln = ctypes.c_uint64()
         for i in range(n):
