@@ -4,6 +4,8 @@
 #include <cassert>
 #include <cmath>
 #include <limits>
+#include <cstdint>
+#include <cstdlib>
 #include <numeric>
 
 namespace rt {
@@ -245,6 +247,178 @@ std::string PoaGraph::generate_consensus(
   return consensus;
 }
 
+
+namespace {
+
+// DP + traceback core, templated on the score type (int16 when the score
+// range allows, halving memory traffic). Returns the REVERSED alignment.
+template <typename ScoreT>
+PoaAlignment dp_and_traceback(const PoaGraph& graph, const char* seq,
+                              uint32_t L, const std::vector<int32_t>& sub,
+                              const std::vector<std::vector<int32_t>>& preds,
+                              std::vector<ScoreT>& h, int8_t match_,
+                              int8_t mismatch_, int8_t gap_) {
+  const uint32_t S = static_cast<uint32_t>(sub.size());
+  const size_t stride = L + 1;
+  // No full-matrix fill: every subgraph row is written before any read (key
+  // order == topological order); only the virtual start row needs values.
+  h.resize(static_cast<size_t>(S + 1) * stride);
+
+  for (uint32_t j = 0; j <= L; ++j) {
+    h[j] = static_cast<ScoreT>(static_cast<int32_t>(j) * gap_);
+  }
+
+  for (uint32_t r = 1; r <= S; ++r) {
+    const int32_t u = sub[r - 1];
+    const char ub = graph.nodes()[u].base;
+    ScoreT* row = h.data() + static_cast<size_t>(r) * stride;
+    const auto& pr = preds[r - 1];
+
+    if (pr.empty()) {
+      // Single virtual predecessor (row 0).
+      const ScoreT* prow = h.data();
+      row[0] = static_cast<ScoreT>(prow[0] + gap_);
+      for (uint32_t j = 1; j <= L; ++j) {
+        const ScoreT diag = static_cast<ScoreT>(
+            prow[j - 1] + (seq[j - 1] == ub ? match_ : mismatch_));
+        const ScoreT up = static_cast<ScoreT>(prow[j] + gap_);
+        ScoreT best = diag > up ? diag : up;
+        const ScoreT left = static_cast<ScoreT>(row[j - 1] + gap_);
+        if (left > best) {
+          best = left;
+        }
+        row[j] = best;
+      }
+    } else {
+      // First predecessor initializes, the rest max-merge.
+      {
+        const ScoreT* prow = h.data() + static_cast<size_t>(pr[0]) * stride;
+        row[0] = static_cast<ScoreT>(prow[0] + gap_);
+        for (uint32_t j = 1; j <= L; ++j) {
+          const ScoreT diag = static_cast<ScoreT>(
+              prow[j - 1] + (seq[j - 1] == ub ? match_ : mismatch_));
+          const ScoreT up = static_cast<ScoreT>(prow[j] + gap_);
+          row[j] = diag > up ? diag : up;
+        }
+      }
+      for (size_t pi = 1; pi < pr.size(); ++pi) {
+        const ScoreT* prow = h.data() + static_cast<size_t>(pr[pi]) * stride;
+        if (static_cast<ScoreT>(prow[0] + gap_) > row[0]) {
+          row[0] = static_cast<ScoreT>(prow[0] + gap_);
+        }
+        for (uint32_t j = 1; j <= L; ++j) {
+          const ScoreT diag = static_cast<ScoreT>(
+              prow[j - 1] + (seq[j - 1] == ub ? match_ : mismatch_));
+          const ScoreT up = static_cast<ScoreT>(prow[j] + gap_);
+          const ScoreT cand = diag > up ? diag : up;
+          if (cand > row[j]) {
+            row[j] = cand;
+          }
+        }
+      }
+      // Horizontal pass.
+      for (uint32_t j = 1; j <= L; ++j) {
+        const ScoreT left = static_cast<ScoreT>(row[j - 1] + gap_);
+        if (left > row[j]) {
+          row[j] = left;
+        }
+      }
+    }
+  }
+
+  // End-node set: subgraph nodes without an out-edge inside the subgraph.
+  // (An edge's dst is in the subgraph iff some preds entry references its
+  // rank; recompute via a membership flag.)
+  std::vector<uint8_t> in_sub(graph.num_nodes(), 0);
+  for (int32_t u : sub) {
+    in_sub[u] = 1;
+  }
+  std::vector<uint8_t> has_out(S, 0);
+  for (uint32_t r = 0; r < S; ++r) {
+    for (int32_t e : graph.nodes()[sub[r]].out_edges) {
+      if (in_sub[graph.edges()[e].dst]) {
+        has_out[r] = 1;
+        break;
+      }
+    }
+  }
+  int32_t best_rank = -1;
+  int64_t best_score = INT64_MIN;
+  for (uint32_t r = 1; r <= S; ++r) {
+    if (!has_out[r - 1]) {
+      const int64_t sc = h[static_cast<size_t>(r) * stride + L];
+      if (sc > best_score) {
+        best_score = sc;
+        best_rank = static_cast<int32_t>(r);
+      }
+    }
+  }
+
+  // Traceback by transition re-checking (H holds exact maxima, so any
+  // satisfying transition lies on an optimal path). Priority: diag, up, left.
+  int32_t r = best_rank;
+  uint32_t j = L;
+  PoaAlignment rev;
+  while (r != 0 || j != 0) {
+    if (r == 0) {
+      rev.emplace_back(-1, static_cast<int32_t>(j) - 1);
+      --j;
+      continue;
+    }
+    const int32_t u = sub[r - 1];
+    const char ub = graph.nodes()[u].base;
+    const ScoreT* row = h.data() + static_cast<size_t>(r) * stride;
+    const auto& pr = preds[r - 1];
+    const int32_t cur = row[j];
+    bool moved = false;
+
+    const int32_t sc = j > 0 ? (seq[j - 1] == ub ? match_ : mismatch_) : 0;
+    if (pr.empty()) {
+      const ScoreT* prow = h.data();
+      if (j > 0 && prow[j - 1] + sc == cur) {
+        rev.emplace_back(u, static_cast<int32_t>(j) - 1);
+        r = 0;
+        --j;
+        moved = true;
+      } else if (prow[j] + gap_ == cur) {
+        rev.emplace_back(u, -1);
+        r = 0;
+        moved = true;
+      }
+    } else {
+      for (int32_t p : pr) {
+        const ScoreT* prow = h.data() + static_cast<size_t>(p) * stride;
+        if (j > 0 && prow[j - 1] + sc == cur) {
+          rev.emplace_back(u, static_cast<int32_t>(j) - 1);
+          r = p;
+          --j;
+          moved = true;
+          break;
+        }
+      }
+      if (!moved) {
+        for (int32_t p : pr) {
+          const ScoreT* prow = h.data() + static_cast<size_t>(p) * stride;
+          if (prow[j] + gap_ == cur) {
+            rev.emplace_back(u, -1);
+            r = p;
+            moved = true;
+            break;
+          }
+        }
+      }
+    }
+    if (!moved) {
+      // Left move (insertion).
+      rev.emplace_back(-1, static_cast<int32_t>(j) - 1);
+      --j;
+    }
+  }
+  return rev;
+}
+
+}  // namespace
+
 PoaAlignment PoaAligner::align(const char* seq, uint32_t len,
                                const PoaGraph& graph, double key_lo,
                                double key_hi) {
@@ -292,156 +466,20 @@ PoaAlignment PoaAligner::align(const char* seq, uint32_t len,
   }
 
   const uint32_t L = len;
-  const size_t stride = L + 1;
-  h_.assign(static_cast<size_t>(S + 1) * stride, kNegInf);
-
-  // Virtual start row.
-  for (uint32_t j = 0; j <= L; ++j) {
-    h_[j] = static_cast<int32_t>(j) * gap_;
-  }
-
-  for (uint32_t r = 1; r <= S; ++r) {
-    const int32_t u = sub_[r - 1];
-    const char ub = graph.nodes()[u].base;
-    int32_t* row = h_.data() + static_cast<size_t>(r) * stride;
-    const auto& pr = preds[r - 1];
-
-    if (pr.empty()) {
-      // Single virtual predecessor (row 0).
-      const int32_t* prow = h_.data();
-      row[0] = prow[0] + gap_;
-      for (uint32_t j = 1; j <= L; ++j) {
-        const int32_t diag =
-            prow[j - 1] + (seq[j - 1] == ub ? match_ : mismatch_);
-        const int32_t up = prow[j] + gap_;
-        int32_t best = diag > up ? diag : up;
-        const int32_t left = row[j - 1] + gap_;
-        if (left > best) {
-          best = left;
-        }
-        row[j] = best;
-      }
-    } else {
-      // First predecessor initializes, the rest max-merge.
-      {
-        const int32_t* prow = h_.data() + static_cast<size_t>(pr[0]) * stride;
-        row[0] = prow[0] + gap_;
-        for (uint32_t j = 1; j <= L; ++j) {
-          const int32_t diag =
-              prow[j - 1] + (seq[j - 1] == ub ? match_ : mismatch_);
-          const int32_t up = prow[j] + gap_;
-          row[j] = diag > up ? diag : up;
-        }
-      }
-      for (size_t pi = 1; pi < pr.size(); ++pi) {
-        const int32_t* prow =
-            h_.data() + static_cast<size_t>(pr[pi]) * stride;
-        if (prow[0] + gap_ > row[0]) {
-          row[0] = prow[0] + gap_;
-        }
-        for (uint32_t j = 1; j <= L; ++j) {
-          const int32_t diag =
-              prow[j - 1] + (seq[j - 1] == ub ? match_ : mismatch_);
-          const int32_t up = prow[j] + gap_;
-          const int32_t cand = diag > up ? diag : up;
-          if (cand > row[j]) {
-            row[j] = cand;
-          }
-        }
-      }
-      // Horizontal pass.
-      for (uint32_t j = 1; j <= L; ++j) {
-        const int32_t left = row[j - 1] + gap_;
-        if (left > row[j]) {
-          row[j] = left;
-        }
-      }
-    }
-  }
-
-  // Out-degree within the subgraph decides the end-node set.
-  std::vector<uint8_t> has_out(S, 0);
-  for (uint32_t r = 0; r < S; ++r) {
-    for (int32_t e : graph.nodes()[sub_[r]].out_edges) {
-      if (rank_of_[graph.edges()[e].dst] > 0) {
-        has_out[r] = 1;
-        break;
-      }
-    }
-  }
-  int32_t best_rank = -1;
-  int32_t best_score = kNegInf;
-  for (uint32_t r = 1; r <= S; ++r) {
-    if (!has_out[r - 1]) {
-      const int32_t s = h_[static_cast<size_t>(r) * stride + L];
-      if (s > best_score) {
-        best_score = s;
-        best_rank = static_cast<int32_t>(r);
-      }
-    }
-  }
-
-  // Traceback by transition re-checking (H holds exact maxima, so any
-  // satisfying transition lies on an optimal path). Priority: diag, up, left.
-  int32_t r = best_rank;
-  uint32_t j = L;
+  // Score range bound: |score| <= (S + L + 2) * max |parameter|. When it
+  // fits int16, the halved DP memory traffic nearly doubles throughput on
+  // this bandwidth-bound loop.
+  const int64_t max_param = std::max<int64_t>(
+      {std::abs((int)match_), std::abs((int)mismatch_), std::abs((int)gap_)});
+  const int64_t bound = static_cast<int64_t>(S + L + 2) * max_param;
   PoaAlignment rev;
-  while (r != 0 || j != 0) {
-    if (r == 0) {
-      rev.emplace_back(-1, static_cast<int32_t>(j) - 1);
-      --j;
-      continue;
-    }
-    const int32_t u = sub_[r - 1];
-    const char ub = graph.nodes()[u].base;
-    const int32_t* row = h_.data() + static_cast<size_t>(r) * stride;
-    const auto& pr = preds[r - 1];
-    const int32_t cur = row[j];
-    bool moved = false;
-
-    const int32_t s = j > 0 ? (seq[j - 1] == ub ? match_ : mismatch_) : 0;
-    if (pr.empty()) {
-      const int32_t* prow = h_.data();
-      if (j > 0 && prow[j - 1] + s == cur) {
-        rev.emplace_back(u, static_cast<int32_t>(j) - 1);
-        r = 0;
-        --j;
-        moved = true;
-      } else if (prow[j] + gap_ == cur) {
-        rev.emplace_back(u, -1);
-        r = 0;
-        moved = true;
-      }
-    } else {
-      for (int32_t p : pr) {
-        const int32_t* prow = h_.data() + static_cast<size_t>(p) * stride;
-        if (j > 0 && prow[j - 1] + s == cur) {
-          rev.emplace_back(u, static_cast<int32_t>(j) - 1);
-          r = p;
-          --j;
-          moved = true;
-          break;
-        }
-      }
-      if (!moved) {
-        for (int32_t p : pr) {
-          const int32_t* prow = h_.data() + static_cast<size_t>(p) * stride;
-          if (prow[j] + gap_ == cur) {
-            rev.emplace_back(u, -1);
-            r = p;
-            moved = true;
-            break;
-          }
-        }
-      }
-    }
-    if (!moved) {
-      // Left move (insertion).
-      rev.emplace_back(-1, static_cast<int32_t>(j) - 1);
-      --j;
-    }
+  if (bound < 30000) {
+    rev = dp_and_traceback<int16_t>(graph, seq, L, sub_, preds, h16_, match_,
+                                    mismatch_, gap_);
+  } else {
+    rev = dp_and_traceback<int32_t>(graph, seq, L, sub_, preds, h_, match_,
+                                    mismatch_, gap_);
   }
-
   result.assign(rev.rbegin(), rev.rend());
   return result;
 }

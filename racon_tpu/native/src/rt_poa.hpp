@@ -109,8 +109,8 @@ class PoaAligner {
 
  private:
   int8_t match_, mismatch_, gap_;
-  std::vector<int32_t> h_;       // (S+1) x (L+1) scores
-  std::vector<uint8_t> tb_;      // move | (pred_slot << 2)
+  std::vector<int32_t> h_;       // (S+1) x (L+1) scores (wide-range fallback)
+  std::vector<int16_t> h16_;     // narrow-range fast path
   std::vector<int32_t> sub_;     // subgraph node ids in topo order
   std::vector<int32_t> rank_of_; // node id -> rank (1-based), 0 = absent
 };
