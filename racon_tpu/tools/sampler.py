@@ -73,21 +73,29 @@ def _write_record(out, rec, fmt):
         out.write(f"{name}\n{data}\n+\n{qual}\n")
 
 
+def subsample_path(path: str, coverage: int, outdir: str) -> str:
+    """Output naming contract shared with the wrapper's resume probing."""
+    _, ext = _fmt(path)
+    base_name = os.path.basename(path).split(".")[0]
+    return os.path.join(outdir, f"{base_name}_{coverage}x{ext}")
+
+
 def subsample(path: str, ref_length: int, coverage: int, outdir: str,
               seed: int = 42) -> str:
     """Random subsample of whole reads down to coverage * ref_length bases
-    (the rampler contract)."""
-    fmt, ext = _fmt(path)
+    (the rampler contract). The output appears atomically (tmp + rename) so
+    an interrupted run never leaves a truncated file for --resume to trust."""
+    fmt, _ = _fmt(path)
     target_bases = ref_length * coverage
 
     records = list(_records(path))
     total = sum(len(r[1]) for r in records)
     rng = random.Random(seed)
 
-    base_name = os.path.basename(path).split(".")[0]
-    out_path = os.path.join(outdir, f"{base_name}_{coverage}x{ext}")
+    out_path = subsample_path(path, coverage, outdir)
 
-    with open(out_path, "w") as out:
+    tmp_path = out_path + ".tmp"
+    with open(tmp_path, "w") as out:
         if total <= target_bases:
             for rec in records:
                 _write_record(out, rec, fmt)
@@ -103,6 +111,7 @@ def subsample(path: str, ref_length: int, coverage: int, outdir: str,
                 picked += len(records[i][1])
             for i in sorted(chosen):
                 _write_record(out, records[i], fmt)
+    os.replace(tmp_path, out_path)
     return out_path
 
 

@@ -27,16 +27,73 @@ def eprint(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
+def _check_resume_stamp(args, work_dir: str) -> None:
+    """Refuse to reuse checkpoints produced with different inputs/flags."""
+    import json
+
+    def mtime(p):
+        try:
+            return os.path.getmtime(p)
+        except OSError:
+            return None
+
+    stamp = {
+        "sequences": os.path.abspath(args.sequences),
+        "sequences_mtime": mtime(args.sequences),
+        "overlaps": os.path.abspath(args.overlaps),
+        "overlaps_mtime": mtime(args.overlaps),
+        "targets": os.path.abspath(args.target_sequences),
+        "targets_mtime": mtime(args.target_sequences),
+        "split": args.split,
+        "subsample": args.subsample,
+        "flags": [args.include_unpolished, args.fragment_correction,
+                  str(args.window_length), str(args.quality_threshold),
+                  str(args.error_threshold), str(args.match),
+                  str(args.mismatch), str(args.gap)],
+    }
+    stamp_path = os.path.join(work_dir, "wrapper_stamp.json")
+    if os.path.isfile(stamp_path):
+        with open(stamp_path) as f:
+            old = json.load(f)
+        if old != stamp:
+            eprint("[racon_tpu::wrapper] error: resume directory was "
+                   "created with different inputs or parameters; clear it "
+                   "or choose another --resume directory")
+            sys.exit(1)
+    else:
+        tmp = stamp_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(stamp, f)
+        os.replace(tmp, stamp_path)
+
+
 def run(args) -> int:
-    work_dir = os.path.join(
-        os.getcwd(), f"racon_tpu_work_directory_{time.time()}")
-    os.makedirs(work_dir, exist_ok=True)
+    # --resume keeps a persistent work directory with per-chunk outputs:
+    # rerunning skips already-polished chunks (coarse checkpoint/restart —
+    # the reference offers restartability only by manually re-driving its
+    # --split chunks; SURVEY.md §5.4).
+    resume = getattr(args, "resume", None)
+    if resume:
+        work_dir = os.path.abspath(resume)
+        os.makedirs(work_dir, exist_ok=True)
+    else:
+        work_dir = os.path.join(
+            os.getcwd(), f"racon_tpu_work_directory_{time.time()}")
+        os.makedirs(work_dir, exist_ok=True)
     try:
         sequences = os.path.abspath(args.sequences)
+        if resume:
+            _check_resume_stamp(args, work_dir)
         if args.subsample is not None:
-            eprint("[racon_tpu::wrapper] subsampling sequences")
             ref_len, cov = int(args.subsample[0]), int(args.subsample[1])
-            sequences = sampler.subsample(sequences, ref_len, cov, work_dir)
+            sub_path = sampler.subsample_path(sequences, cov, work_dir)
+            if resume and os.path.isfile(sub_path):
+                eprint("[racon_tpu::wrapper] reusing subsampled sequences")
+                sequences = sub_path
+            else:
+                eprint("[racon_tpu::wrapper] subsampling sequences")
+                sequences = sampler.subsample(sequences, ref_len, cov,
+                                              work_dir)
 
         targets = [os.path.abspath(args.target_sequences)]
         if args.split is not None:
@@ -46,7 +103,15 @@ def run(args) -> int:
             eprint(f"[racon_tpu::wrapper] total number of splits: "
                    f"{len(targets)}")
 
-        for part in targets:
+        for idx, part in enumerate(targets):
+            out_path = os.path.join(work_dir, f"polished_{idx}.fasta")
+            if resume and os.path.isfile(out_path):
+                eprint(f"[racon_tpu::wrapper] chunk {idx}: reusing "
+                       "checkpointed result")
+                with open(out_path) as f:
+                    shutil.copyfileobj(f, sys.stdout)
+                continue
+
             eprint("[racon_tpu::wrapper] polishing chunk")
             polisher = create_polisher(
                 sequences, os.path.abspath(args.overlaps), part,
@@ -58,15 +123,27 @@ def run(args) -> int:
                 match=int(args.match), mismatch=int(args.mismatch),
                 gap=int(args.gap), num_threads=int(args.threads))
             polisher.initialize()
-            for name, data in polisher.polish(not args.include_unpolished):
-                sys.stdout.write(f">{name}\n{data}\n")
+            results = polisher.polish(not args.include_unpolished)
+            if resume:
+                # Stream into the checkpoint, publish atomically, then echo.
+                tmp = out_path + ".tmp"
+                with open(tmp, "w") as f:
+                    for name, data in results:
+                        f.write(f">{name}\n{data}\n")
+                os.replace(tmp, out_path)
+                with open(out_path) as f:
+                    shutil.copyfileobj(f, sys.stdout)
+            else:
+                for name, data in results:
+                    sys.stdout.write(f">{name}\n{data}\n")
         return 0
     finally:
-        try:
-            shutil.rmtree(work_dir)
-        except OSError:
-            eprint("[racon_tpu::wrapper] warning: unable to clean work "
-                   "directory!")
+        if not resume:
+            try:
+                shutil.rmtree(work_dir)
+            except OSError:
+                eprint("[racon_tpu::wrapper] warning: unable to clean work "
+                       "directory!")
 
 
 def main(argv=None) -> int:
@@ -93,6 +170,9 @@ def main(argv=None) -> int:
     p.add_argument("-g", "--gap", default=-8)
     p.add_argument("-t", "--threads", default=1)
     p.add_argument("--tpu", action="store_true")
+    p.add_argument("--resume", metavar="DIR",
+                   help="persistent work directory with per-chunk "
+                   "checkpoints; rerunning skips finished chunks")
     return run(p.parse_args(argv))
 
 

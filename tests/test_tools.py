@@ -89,3 +89,25 @@ def test_wrapper_end_to_end(tmp_path):
     # work directory cleaned up
     assert not any(d.startswith("racon_tpu_work_directory")
                    for d in os.listdir(tmp_path))
+
+
+def test_wrapper_resume_checkpoints(tmp_path):
+    """--resume persists per-chunk outputs and reuses them on rerun."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo)
+    ckpt = tmp_path / "ckpt"
+    cmd = [sys.executable, "-m", "racon_tpu.tools.wrapper",
+           "--resume", str(ckpt),
+           "-m", "5", "-x", "-4", "-g", "-8",
+           DATA + "sample_reads.fastq.gz", DATA + "sample_overlaps.sam.gz",
+           DATA + "sample_layout.fasta.gz"]
+    first = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
+                           cwd=str(tmp_path), env=env)
+    assert first.returncode == 0, first.stderr
+    assert (ckpt / "polished_0.fasta").is_file()
+
+    second = subprocess.run(cmd, capture_output=True, text=True, timeout=120,
+                            cwd=str(tmp_path), env=env)
+    assert second.returncode == 0, second.stderr
+    assert "reusing checkpointed result" in second.stderr
+    assert second.stdout == first.stdout
