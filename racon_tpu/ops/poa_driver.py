@@ -119,15 +119,33 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
         for depth_bucket, bucket_jobs in sorted(buckets.items()):
             cfg = make_config(max(window_length, 1), depth_bucket, match,
                               mismatch, gap)
-            kernel = _build_kernel(cfg, B, use_pallas)
+            bucket_pallas = use_pallas
+            kernel = _build_kernel(cfg, B, bucket_pallas)
             # Sequential loops run lock-step across the batch, so keep
             # batches depth-homogeneous.
             bucket_jobs.sort(key=lambda job: len(job[2]))
-            pad = B if (use_pallas or n_dev > 1) else None
             for off in range(0, len(bucket_jobs), B):
                 chunk = bucket_jobs[off:off + B]
-                _run_chunk(pipeline, kernel, cfg, chunk, trim, stats,
-                           fallback, use_pallas=use_pallas, pad_to=pad)
+                pad = B if (bucket_pallas or n_dev > 1) else None
+                try:
+                    _run_chunk(pipeline, kernel, cfg, chunk, trim, stats,
+                               fallback, use_pallas=bucket_pallas,
+                               pad_to=pad)
+                except Exception as e:  # noqa: BLE001
+                    if not bucket_pallas:
+                        raise
+                    # Mosaic compile/runtime failure: degrade to the XLA
+                    # kernel for the rest of this geometry (same fallback
+                    # philosophy as the per-window host fallback).
+                    print("[racon_tpu::poa] WARNING: pallas kernel failed "
+                          f"({type(e).__name__}: {e}); falling back to the "
+                          "XLA kernel", file=sys.stderr)
+                    bucket_pallas = False
+                    kernel = _build_kernel(cfg, B, bucket_pallas)
+                    pad = B if n_dev > 1 else None
+                    _run_chunk(pipeline, kernel, cfg, chunk, trim, stats,
+                               fallback, use_pallas=bucket_pallas,
+                               pad_to=pad)
             if progress:
                 print(f"[racon_tpu::poa] bucket depth<={depth_bucket}: "
                       f"{len(bucket_jobs)} windows", file=sys.stderr)
