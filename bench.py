@@ -3,23 +3,53 @@
 Prints ONE JSON line:
   {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
 
-Dataset: the lambda-phage polishing workload (reads FASTQ + PAF overlaps +
-draft layout, window=500, wrapper scores m=5 x=-4 g=-8 — the reference test
-suite's standard scenario, /root/reference/test/racon_test.cpp:86-107).
-value = polished megabases per second of end-to-end wall time (parse ->
-polished FASTA) on the accelerated path; vs_baseline = speedup over the
-host CPU path measured on the same machine (the reference's own comparison
-axis: accelerated backend vs its CPU SPOA path).
+Workload: a synthetic ONT-like polishing job (default 0.5 Mbp genome, 30x
+reads at ~11% error, PAF overlaps from simulation truth, window=500 — the
+shape of BASELINE.json's E. coli config, scaled to this machine; set
+RACON_TPU_BENCH_MBP to change the size). value = polished megabases per
+second of end-to-end wall time (parse -> polished FASTA) on the accelerated
+path; vs_baseline = speedup over the host CPU path measured on the same
+machine (the reference's comparison axis: accelerated backend vs its CPU
+SPOA path).
 """
 
 import json
+import os
 import subprocess
 import sys
 import time
 
-D = "/root/reference/test/data/"
+MBP = float(os.environ.get("RACON_TPU_BENCH_MBP", "0.5"))
+COVERAGE = 30
 ARGS = dict(window_length=500, quality_threshold=10.0, error_threshold=0.3,
             match=5, mismatch=-4, gap=-8, num_threads=1)
+
+
+def dataset():
+    import hashlib
+    import inspect
+    import shutil
+
+    from racon_tpu.tools import simulate
+
+    # Cache keyed by size/coverage AND the generator source, so simulator
+    # changes invalidate stale data; built in a temp dir and renamed into
+    # place so concurrent bench runs never see half-written files.
+    src_tag = hashlib.sha256(
+        inspect.getsource(simulate).encode()).hexdigest()[:12]
+    outdir = f"/tmp/racon_tpu_bench_{MBP}mbp_{COVERAGE}x_{src_tag}"
+    if not os.path.isdir(outdir):
+        tmpdir = outdir + f".tmp{os.getpid()}"
+        shutil.rmtree(tmpdir, ignore_errors=True)
+        paths = simulate.generate(tmpdir, mbp=MBP, coverage=COVERAGE)
+        try:
+            os.rename(tmpdir, outdir)
+        except OSError:
+            shutil.rmtree(tmpdir, ignore_errors=True)  # another run won
+    return {k: os.path.join(outdir, f)
+            for k, f in (("reads", "reads.fastq"),
+                         ("overlaps", "overlaps.paf"),
+                         ("draft", "draft.fasta"))}
 
 
 def device_healthy(timeout_s: int = 120) -> bool:
@@ -35,13 +65,12 @@ def device_healthy(timeout_s: int = 120) -> bool:
         return False
 
 
-def run(backend: str):
+def run(backend: str, paths):
     import racon_tpu
 
     t0 = time.time()
-    p = racon_tpu.create_polisher(
-        D + "sample_reads.fastq.gz", D + "sample_overlaps.paf.gz",
-        D + "sample_layout.fasta.gz", backend=backend, **ARGS)
+    p = racon_tpu.create_polisher(paths["reads"], paths["overlaps"],
+                                  paths["draft"], backend=backend, **ARGS)
     p.initialize()
     res = p.polish(True)
     dt = time.time() - t0
@@ -50,6 +79,8 @@ def run(backend: str):
 
 
 def main():
+    paths = dataset()
+
     degraded = not device_healthy()
     if degraded:
         # Dead tunnel: measure the device *code path* on the CPU backend so
@@ -65,16 +96,16 @@ def main():
         # Warm the device path once so compile time is not billed as
         # throughput (compiled kernels are cached for the steady-state
         # measurement).
-        run("tpu")
+        run("tpu", paths)
 
-    bp_tpu, dt_tpu = run("tpu")
-    bp_cpu, dt_cpu = run("cpu")
+    bp_tpu, dt_tpu = run("tpu", paths)
+    bp_cpu, dt_cpu = run("cpu", paths)
 
     mbps_tpu = bp_tpu / dt_tpu / 1e6
     mbps_cpu = bp_cpu / dt_cpu / 1e6
     print(json.dumps({
-        "metric": "polished Mbp/sec (lambda 47.5kb, PAF+qual, w=500, "
-                  "end-to-end)" + suffix,
+        "metric": f"polished Mbp/sec (synthetic ONT {MBP} Mbp {COVERAGE}x, "
+                  "PAF, w=500, end-to-end)" + suffix,
         "value": round(mbps_tpu, 4),
         "unit": "Mbp/s",
         "vs_baseline": round(mbps_tpu / mbps_cpu, 3),
