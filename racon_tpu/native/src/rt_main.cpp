@@ -3,6 +3,7 @@
 // path lives behind the Python driver (python -m racon_tpu.cli --tpu),
 // which shares this same native pipeline through the C ABI.
 #include <getopt.h>
+#include <sys/stat.h>
 
 #include <exception>
 
@@ -13,6 +14,7 @@
 #include <vector>
 
 #include "rt_pipeline.hpp"
+#include "rt_sampler.hpp"
 
 #ifndef RT_VERSION
 #define RT_VERSION "0.1.0"
@@ -64,7 +66,73 @@ void help() {
 
 }  // namespace
 
+namespace {
+
+// rampler-compatible subcommands:
+//   racon_tpu [-o DIR] subsample <sequences> <ref_length> <coverage>
+//   racon_tpu [-o DIR] split <sequences> <chunk_size>
+int sampler_main(int argc, char** argv) {
+  std::string outdir = ".";
+  int i = 1;
+  if (std::string(argv[i]) == "-o" || std::string(argv[i]) == "--out-directory") {
+    if (i + 1 >= argc) {
+      std::fprintf(stderr, "[racon_tpu::sampler] error: -o needs a value\n");
+      return 1;
+    }
+    outdir = argv[i + 1];
+    i += 2;
+  }
+  ::mkdir(outdir.c_str(), 0755);  // EEXIST is fine
+  const std::string mode = argv[i];
+  try {
+    if (mode == "subsample") {
+      if (i + 3 >= argc) {
+        std::fprintf(stderr, "usage: racon_tpu [-o DIR] subsample "
+                             "<sequences> <ref_length> <coverage>\n");
+        return 1;
+      }
+      rt::sampler_subsample(argv[i + 1], std::strtoull(argv[i + 2], nullptr, 10),
+                            static_cast<uint32_t>(std::atoi(argv[i + 3])),
+                            outdir);
+    } else {
+      if (i + 2 >= argc) {
+        std::fprintf(stderr, "usage: racon_tpu [-o DIR] split <sequences> "
+                             "<chunk_size>\n");
+        return 1;
+      }
+      rt::sampler_split(argv[i + 1],
+                        std::strtoull(argv[i + 2], nullptr, 10), outdir);
+    }
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "%s", e.what());
+    return 1;
+  }
+  return 0;
+}
+
+bool is_sampler_invocation(int argc, char** argv) {
+  // Subcommand must be argv[1], or argv[3] after a leading -o DIR.
+  const auto is_mode = [](const char* a) {
+    const std::string s = a;
+    return s == "subsample" || s == "split";
+  };
+  if (argc > 1 && is_mode(argv[1])) {
+    return true;
+  }
+  if (argc > 3 && (std::string(argv[1]) == "-o" ||
+                   std::string(argv[1]) == "--out-directory")) {
+    return is_mode(argv[3]);
+  }
+  return false;
+}
+
+}  // namespace
+
 int main(int argc, char** argv) {
+  if (argc > 1 && is_sampler_invocation(argc, argv)) {
+    return sampler_main(argc, argv);
+  }
+
   rt::PipelineParams params;
   bool drop_unpolished = true;
 

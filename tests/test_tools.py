@@ -91,6 +91,35 @@ def test_wrapper_end_to_end(tmp_path):
                    for d in os.listdir(tmp_path))
 
 
+NATIVE_BIN = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "racon_tpu", "native", "build", "racon_tpu")
+
+
+def test_native_sampler_split_and_subsample(tmp_path):
+    """rampler-compatible subcommands of the native binary."""
+    recs = [(f"r{i}", "ACGT" * 100) for i in range(10)]
+    src = tmp_path / "seqs.fasta"
+    _write_fasta(src, recs)
+    out = subprocess.run(
+        [NATIVE_BIN, "-o", str(tmp_path / "out"), "split", str(src), "1000"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    chunks = sorted((tmp_path / "out").glob("seqs_*.fasta"))
+    assert len(chunks) == 4
+    total = sum(sum(1 for l in open(c) if l.startswith(">")) for c in chunks)
+    assert total == 10
+
+    out = subprocess.run(
+        [NATIVE_BIN, "-o", str(tmp_path / "out"), "subsample", str(src),
+         "400", "2"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    sub = tmp_path / "out" / "seqs_2x.fasta"
+    n = sum(1 for l in open(sub) if l.startswith(">"))
+    assert 2 <= n <= 3  # ~800 bases at 400 bp each, one overshoot allowed
+
+
 def test_wrapper_resume_checkpoints(tmp_path):
     """--resume persists per-chunk outputs and reuses them on rerun."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
