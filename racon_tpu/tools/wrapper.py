@@ -4,11 +4,11 @@ large for one pipeline pass.
 
 Capability parity with the reference wrapper
 (/root/reference/scripts/racon_wrapper.py): same flags (--split,
---subsample REF_LEN COV), same work-directory lifecycle, chunks processed
-sequentially with results streamed to stdout. Instead of shelling out to a
-racon binary it drives the pipeline in-process; on multi-host deployments
-each chunk is independent, so chunks can be fanned out across hosts with a
-plain ordered gather (no collectives — see SURVEY.md §2.3).
+--subsample REF_LEN COV), same work-directory lifecycle, results streamed to
+stdout in chunk order. Beyond the reference: --resume checkpoints, and
+--jobs N fans chunks out to N worker processes — the multi-host topology
+(chunks are independent; hosts need no collectives, only this ordered
+gather over their outputs — SURVEY.md §2.3/§5.8).
 """
 
 from __future__ import annotations
@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import os
 import shutil
+import subprocess
 import sys
 import time
 
@@ -103,6 +104,11 @@ def run(args) -> int:
             eprint(f"[racon_tpu::wrapper] total number of splits: "
                    f"{len(targets)}")
 
+        jobs = int(getattr(args, "jobs", 1) or 1)
+        if jobs > 1 and len(targets) > 1:
+            return _run_distributed(args, sequences, targets, work_dir,
+                                    resume, jobs)
+
         for idx, part in enumerate(targets):
             out_path = os.path.join(work_dir, f"polished_{idx}.fasta")
             if resume and os.path.isfile(out_path):
@@ -146,6 +152,68 @@ def run(args) -> int:
                        "directory!")
 
 
+def _run_distributed(args, sequences, targets, work_dir, resume,
+                     jobs) -> int:
+    """Fan chunks out to worker processes (one per simulated host), gather
+    their outputs in chunk order. Each worker is a fully independent
+    pipeline — the multi-host scale-out needs no collectives."""
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    pending = []
+    for idx, part in enumerate(targets):
+        out_path = os.path.join(work_dir, f"polished_{idx}.fasta")
+        if resume and os.path.isfile(out_path):
+            continue
+        pending.append((idx, part, out_path))
+
+    running = []
+
+    def launch(idx, part, out_path):
+        cmd = [sys.executable, "-m", "racon_tpu.cli",
+               "-w", str(args.window_length), "-q",
+               str(args.quality_threshold), "-e", str(args.error_threshold),
+               "-m", str(args.match), "-x", str(args.mismatch),
+               "-g", str(args.gap), "-t", str(args.threads)]
+        if args.include_unpolished:
+            cmd.append("-u")
+        if args.fragment_correction:
+            cmd.append("-f")
+        if args.tpu:
+            cmd.append("--tpu")
+        cmd += [sequences, os.path.abspath(args.overlaps), part]
+        tmp = out_path + ".tmp"
+        eprint(f"[racon_tpu::wrapper] host worker for chunk {idx}")
+        return (idx, out_path, tmp, open(tmp, "wb"),
+                subprocess.Popen(cmd, stdout=subprocess.PIPE, env=env))
+
+    def finish(entry):
+        idx, out_path, tmp, tmp_f, proc = entry
+        shutil.copyfileobj(proc.stdout, tmp_f)
+        proc.wait()
+        tmp_f.close()
+        if proc.returncode != 0:
+            eprint(f"[racon_tpu::wrapper] error: chunk {idx} worker failed")
+            sys.exit(1)
+        os.replace(tmp, out_path)
+
+    i = 0
+    while i < len(pending) or running:
+        while i < len(pending) and len(running) < jobs:
+            running.append(launch(*pending[i]))
+            i += 1
+        finish(running.pop(0))
+
+    # Ordered gather.
+    for idx in range(len(targets)):
+        out_path = os.path.join(work_dir, f"polished_{idx}.fasta")
+        with open(out_path) as f:
+            shutil.copyfileobj(f, sys.stdout)
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="racon-tpu-wrapper",
@@ -173,6 +241,9 @@ def main(argv=None) -> int:
     p.add_argument("--resume", metavar="DIR",
                    help="persistent work directory with per-chunk "
                    "checkpoints; rerunning skips finished chunks")
+    p.add_argument("--jobs", type=int, default=1,
+                   help="polish chunks with this many parallel worker "
+                   "processes (the multi-host fan-out topology)")
     return run(p.parse_args(argv))
 
 

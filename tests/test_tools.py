@@ -120,6 +120,41 @@ def test_native_sampler_split_and_subsample(tmp_path):
     assert 2 <= n <= 3  # ~800 bases at 400 bp each, one overshoot allowed
 
 
+def test_wrapper_parallel_jobs_matches_sequential(tmp_path):
+    """--jobs N (multi-host fan-out topology) must gather chunk outputs in
+    order, byte-identical to the sequential run."""
+    import random
+    rng = random.Random(3)
+    with open(tmp_path / "targets.fasta", "w") as tf, \
+            open(tmp_path / "reads.fasta", "w") as rf, \
+            open(tmp_path / "ovl.sam", "w") as of:
+        of.write("@HD\tVN:1.6\n")
+        for t in range(3):
+            seq = "".join(rng.choice("ACGT") for _ in range(200))
+            tf.write(f">t{t}\n{seq}\n")
+            for i in range(4):
+                rf.write(f">t{t}r{i}\n{seq}\n")
+                of.write(f"t{t}r{i}\t0\tt{t}\t1\t60\t200M\t*\t0\t0\t{seq}"
+                         f"\t*\n")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo)
+    base = [sys.executable, "-m", "racon_tpu.tools.wrapper",
+            "--split", "300", "-m", "5", "-x", "-4", "-g", "-8",
+            str(tmp_path / "reads.fasta"), str(tmp_path / "ovl.sam"),
+            str(tmp_path / "targets.fasta")]
+    seq_run = subprocess.run(base, capture_output=True, text=True,
+                             timeout=600, cwd=str(tmp_path), env=env)
+    assert seq_run.returncode == 0, seq_run.stderr
+    par_run = subprocess.run(base + ["--jobs", "2"], capture_output=True,
+                             text=True, timeout=600, cwd=str(tmp_path),
+                             env=env)
+    assert par_run.returncode == 0, par_run.stderr
+    assert "host worker for chunk" in par_run.stderr
+    assert par_run.stdout == seq_run.stdout
+    assert seq_run.stdout.count(">") == 3
+
+
 def test_wrapper_resume_checkpoints(tmp_path):
     """--resume persists per-chunk outputs and reuses them on rerun."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
