@@ -271,57 +271,45 @@ PoaAlignment dp_and_traceback(const PoaGraph& graph, const char* seq,
   for (uint32_t r = 1; r <= S; ++r) {
     const int32_t u = sub[r - 1];
     const char ub = graph.nodes()[u].base;
-    ScoreT* row = h.data() + static_cast<size_t>(r) * stride;
+    ScoreT* __restrict row = h.data() + static_cast<size_t>(r) * stride;
     const auto& pr = preds[r - 1];
 
-    if (pr.empty()) {
-      // Single virtual predecessor (row 0).
-      const ScoreT* prow = h.data();
+    // Diag/up pass over each predecessor row (vectorizable: row never
+    // aliases a predecessor row — predecessors have strictly lower ranks),
+    // then one sequential horizontal (gap-chain) pass.
+    {
+      const ScoreT* __restrict prow =
+          pr.empty() ? h.data()
+                     : h.data() + static_cast<size_t>(pr[0]) * stride;
       row[0] = static_cast<ScoreT>(prow[0] + gap_);
       for (uint32_t j = 1; j <= L; ++j) {
         const ScoreT diag = static_cast<ScoreT>(
             prow[j - 1] + (seq[j - 1] == ub ? match_ : mismatch_));
         const ScoreT up = static_cast<ScoreT>(prow[j] + gap_);
-        ScoreT best = diag > up ? diag : up;
-        const ScoreT left = static_cast<ScoreT>(row[j - 1] + gap_);
-        if (left > best) {
-          best = left;
-        }
-        row[j] = best;
+        row[j] = diag > up ? diag : up;
       }
-    } else {
-      // First predecessor initializes, the rest max-merge.
-      {
-        const ScoreT* prow = h.data() + static_cast<size_t>(pr[0]) * stride;
+    }
+    for (size_t pi = 1; pi < pr.size(); ++pi) {
+      const ScoreT* __restrict prow =
+          h.data() + static_cast<size_t>(pr[pi]) * stride;
+      if (static_cast<ScoreT>(prow[0] + gap_) > row[0]) {
         row[0] = static_cast<ScoreT>(prow[0] + gap_);
-        for (uint32_t j = 1; j <= L; ++j) {
-          const ScoreT diag = static_cast<ScoreT>(
-              prow[j - 1] + (seq[j - 1] == ub ? match_ : mismatch_));
-          const ScoreT up = static_cast<ScoreT>(prow[j] + gap_);
-          row[j] = diag > up ? diag : up;
-        }
       }
-      for (size_t pi = 1; pi < pr.size(); ++pi) {
-        const ScoreT* prow = h.data() + static_cast<size_t>(pr[pi]) * stride;
-        if (static_cast<ScoreT>(prow[0] + gap_) > row[0]) {
-          row[0] = static_cast<ScoreT>(prow[0] + gap_);
-        }
-        for (uint32_t j = 1; j <= L; ++j) {
-          const ScoreT diag = static_cast<ScoreT>(
-              prow[j - 1] + (seq[j - 1] == ub ? match_ : mismatch_));
-          const ScoreT up = static_cast<ScoreT>(prow[j] + gap_);
-          const ScoreT cand = diag > up ? diag : up;
-          if (cand > row[j]) {
-            row[j] = cand;
-          }
-        }
-      }
-      // Horizontal pass.
       for (uint32_t j = 1; j <= L; ++j) {
-        const ScoreT left = static_cast<ScoreT>(row[j - 1] + gap_);
-        if (left > row[j]) {
-          row[j] = left;
+        const ScoreT diag = static_cast<ScoreT>(
+            prow[j - 1] + (seq[j - 1] == ub ? match_ : mismatch_));
+        const ScoreT up = static_cast<ScoreT>(prow[j] + gap_);
+        const ScoreT cand = diag > up ? diag : up;
+        if (cand > row[j]) {
+          row[j] = cand;
         }
+      }
+    }
+    // Horizontal pass (inherently sequential gap chain).
+    for (uint32_t j = 1; j <= L; ++j) {
+      const ScoreT left = static_cast<ScoreT>(row[j - 1] + gap_);
+      if (left > row[j]) {
+        row[j] = left;
       }
     }
   }
