@@ -1,0 +1,108 @@
+"""Polish-phase semantics: drop-unpolished behavior and the opt-in device
+aligner phase (reference behaviors: src/polisher.cpp:520-527 emit rule;
+cuda aligner claiming src/cuda/cudapolisher.cpp:74-214)."""
+
+import random
+
+import pytest
+
+import racon_tpu
+from racon_tpu import native
+
+
+def _dataset(tmp_path, rng, with_orphan_target=True):
+    """Two targets; the second gets no overlaps (stays unpolished)."""
+    t0 = "".join(rng.choice("ACGT") for _ in range(300))
+    t1 = "".join(rng.choice("ACGT") for _ in range(250))
+    with open(tmp_path / "targets.fasta", "w") as f:
+        f.write(f">t0\n{t0}\n")
+        if with_orphan_target:
+            f.write(f">t1\n{t1}\n")
+    with open(tmp_path / "reads.fasta", "w") as rf, \
+            open(tmp_path / "ovl.paf", "w") as of:
+        for i in range(4):
+            rf.write(f">r{i}\n{t0}\n")
+            of.write(f"r{i}\t{len(t0)}\t0\t{len(t0)}\t+\tt0\t{len(t0)}\t0\t"
+                     f"{len(t0)}\t{len(t0)}\t{len(t0)}\t60\n")
+    return t0, t1
+
+
+def test_drop_unpolished_default(tmp_path):
+    rng = random.Random(2)
+    t0, _ = _dataset(tmp_path, rng)
+    p = racon_tpu.CpuPolisher(str(tmp_path / "reads.fasta"),
+                              str(tmp_path / "ovl.paf"),
+                              str(tmp_path / "targets.fasta"),
+                              window_length=100, match=5, mismatch=-4,
+                              gap=-8)
+    p.initialize()
+    res = p.polish(True)
+    # only the covered target survives
+    assert [n.split()[0] for n, _ in res] == ["t0"]
+    assert res[0][1] == t0
+
+
+def test_include_unpolished(tmp_path):
+    rng = random.Random(2)
+    t0, t1 = _dataset(tmp_path, rng)
+    p = racon_tpu.CpuPolisher(str(tmp_path / "reads.fasta"),
+                              str(tmp_path / "ovl.paf"),
+                              str(tmp_path / "targets.fasta"),
+                              window_length=100, match=5, mismatch=-4,
+                              gap=-8)
+    p.initialize()
+    res = p.polish(False)
+    names = [n.split()[0] for n, _ in res]
+    assert names == ["t0", "t1"]
+    assert res[1][1] == t1  # orphan target passes through unmodified
+
+
+def test_device_aligner_phase_opt_in(tmp_path, monkeypatch):
+    """RACON_TPU_DEVICE_ALIGNER=1 serves PAF overlaps on the device
+    aligner; result equals the host-aligned run."""
+    rng = random.Random(4)
+    truth = "".join(rng.choice("ACGT") for _ in range(400))
+
+    def mutate(s, rate):
+        out = []
+        for c in s:
+            r = rng.random()
+            if r < rate / 2:
+                out.append(rng.choice("ACGT"))
+            elif r < rate:
+                continue
+            else:
+                out.append(c)
+        return "".join(out)
+
+    draft = mutate(truth, 0.02)
+    reads = [mutate(truth, 0.05) for _ in range(5)]
+    with open(tmp_path / "t.fasta", "w") as f:
+        f.write(f">t\n{draft}\n")
+    with open(tmp_path / "r.fasta", "w") as rf, \
+            open(tmp_path / "o.paf", "w") as of:
+        for i, r in enumerate(reads):
+            rf.write(f">r{i}\n{r}\n")
+            of.write(f"r{i}\t{len(r)}\t0\t{len(r)}\t+\tt\t{len(draft)}\t0\t"
+                     f"{len(draft)}\t{min(len(r), len(draft))}\t"
+                     f"{max(len(r), len(draft))}\t60\n")
+
+    def run(device):
+        monkeypatch.setenv("RACON_TPU_DEVICE_ALIGNER",
+                           "1" if device else "0")
+        p = racon_tpu.TpuPolisher(str(tmp_path / "r.fasta"),
+                                  str(tmp_path / "o.paf"),
+                                  str(tmp_path / "t.fasta"),
+                                  window_length=100, match=5, mismatch=-4,
+                                  gap=-8)
+        p.initialize()
+        return p.polish(True)
+
+    dev = run(True)
+    host = run(False)
+    assert len(dev) == len(host) == 1
+    # Equally-optimal alignments may break ties differently; consensus must
+    # stay within a pinned sliver of each other and near the truth.
+    d = native.edit_distance(dev[0][1].encode(), host[0][1].encode())
+    assert d <= 2, d
+    assert native.edit_distance(dev[0][1].encode(), truth.encode()) <= 8
