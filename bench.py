@@ -83,20 +83,27 @@ def main():
 
     degraded = not device_healthy()
     if degraded:
-        # Dead tunnel: measure the device *code path* on the CPU backend so
-        # the benchmark still completes (flagged in the metric name); a
-        # single unwarmed run keeps the degraded mode bounded.
-        print("[bench] WARNING: TPU device unreachable; running the device "
-              "path on the CPU backend", file=sys.stderr)
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        suffix = " [TPU UNREACHABLE: device path on CPU backend]"
-    else:
-        suffix = ""
-        # Warm the device path once so compile time is not billed as
-        # throughput (compiled kernels are cached for the steady-state
-        # measurement).
-        run("tpu", paths)
+        # Dead tunnel: emulating the device path on the CPU backend is
+        # unboundedly slow and measures nothing real, so report the host
+        # path only, flagged, with vs_baseline 0 (= no device measurement).
+        print("[bench] WARNING: TPU device unreachable; reporting host-path "
+              "throughput only", file=sys.stderr)
+        bp_cpu, dt_cpu = run("cpu", paths)
+        mbps_cpu = bp_cpu / dt_cpu / 1e6
+        print(json.dumps({
+            "metric": f"polished Mbp/sec (synthetic ONT {MBP} Mbp "
+                      f"{COVERAGE}x, PAF, w=500, end-to-end) "
+                      "[TPU UNREACHABLE: host path only]",
+            "value": round(mbps_cpu, 4),
+            "unit": "Mbp/s",
+            "vs_baseline": 0.0,
+        }))
+        print(f"[bench] cpu: {bp_cpu} bp in {dt_cpu:.1f}s", file=sys.stderr)
+        return
+
+    # Warm the device path once so compile time is not billed as throughput
+    # (compiled kernels are cached for the steady-state measurement).
+    run("tpu", paths)
 
     bp_tpu, dt_tpu = run("tpu", paths)
     bp_cpu, dt_cpu = run("cpu", paths)
@@ -105,7 +112,7 @@ def main():
     mbps_cpu = bp_cpu / dt_cpu / 1e6
     print(json.dumps({
         "metric": f"polished Mbp/sec (synthetic ONT {MBP} Mbp {COVERAGE}x, "
-                  "PAF, w=500, end-to-end)" + suffix,
+                  "PAF, w=500, end-to-end)",
         "value": round(mbps_tpu, 4),
         "unit": "Mbp/s",
         "vs_baseline": round(mbps_tpu / mbps_cpu, 3),
