@@ -62,6 +62,77 @@ def test_pallas_driver_path_end_to_end(tmp_path, monkeypatch):
     assert res[0][1] == target  # perfect reads -> perfect consensus
 
 
+def test_pallas_production_geometry_real_window():
+    """Production-size config (N=1536, L=768, BB=512) on a real lambda
+    window: catches geometry-dependent bugs the small-config differentials
+    can't (tiling, padding, order-insert at scale)."""
+    import os
+
+    from tests.conftest import DATA
+    if not os.path.isdir(DATA):
+        pytest.skip(f"lambda test data not found at {DATA} "
+                    "(set RACON_TPU_TEST_DATA)")
+
+    import racon_tpu
+    from racon_tpu.ops import poa_driver
+
+    pl = racon_tpu.Pipeline(DATA + "sample_reads.fastq.gz",
+                            DATA + "sample_overlaps.sam.gz",
+                            DATA + "sample_layout.fasta.gz",
+                            match=5, mismatch=-4, gap=-8)
+    pl.initialize()
+    target = next((i for i in range(pl.num_windows())
+                   if 20 <= pl.window_info(i)[0] - 1 <= 32), None)
+    if target is None:
+        pytest.skip("no window with 21-32 layers in this dataset")
+    wx = pl.export_window(target)
+
+    cfg = poa_driver.make_config(512, 32, 5, -4, -8)
+    pk = poa_pallas.build_pallas_poa_kernel(cfg, interpret=True)(1)
+
+    B = 1
+    bb = np.zeros((B, cfg.max_backbone), np.int32)
+    bbw = np.zeros((B, cfg.max_backbone), np.int32)
+    bl = np.zeros((B, 1), np.int32)
+    nl = np.zeros((B, 1), np.int32)
+    seqs = np.zeros((B, cfg.depth, cfg.max_len), np.int32)
+    ws = np.zeros((B, cfg.depth, cfg.max_len), np.int32)
+    lens = np.zeros((B, cfg.depth), np.int32)
+    bg = np.zeros((B, cfg.depth), np.int32)
+    en = np.zeros((B, cfg.depth), np.int32)
+    L = len(wx.backbone)
+    bb[0, :L] = encode(wx.backbone)
+    bbw[0, :L] = wx.backbone_weights
+    bl[0, 0] = L
+    keep = [j for j in range(len(wx.lens))
+            if 0 < wx.lens[j] <= cfg.max_len][:cfg.depth]
+    nl[0, 0] = len(keep)
+    off = np.concatenate([[0], np.cumsum(wx.lens)]).astype(np.int64)
+    layers, quals = [], []
+    for li, j in enumerate(keep):
+        ll = int(wx.lens[j])
+        seqs[0, li, :ll] = encode(wx.bases[off[j]:off[j] + ll])
+        ws[0, li, :ll] = wx.weights[off[j]:off[j] + ll]
+        lens[0, li] = ll
+        bg[0, li] = wx.begins[j]
+        en[0, li] = wx.ends[j]
+        layers.append(wx.bases[off[j]:off[j] + ll].tobytes())
+        quals.append((wx.weights[off[j]:off[j] + ll] + 33).astype(
+            np.uint8).tobytes())
+
+    cb, cc, cl, fl, nn = (np.asarray(x)
+                          for x in pk(bl, nl, lens, bg, en, bb, bbw, seqs,
+                                      ws))
+    assert not fl[0, 0]
+    dev = decode(cb[0, :cl[0, 0]])
+    host, _ = native.window_consensus(
+        wx.backbone.tobytes(), layers, quals=quals,
+        backbone_qual=(wx.backbone_weights + 33).astype(np.uint8).tobytes(),
+        begins=[int(wx.begins[j]) for j in keep],
+        ends=[int(wx.ends[j]) for j in keep], trim=False)
+    assert dev == host
+
+
 def test_pallas_failure_degrades_to_xla_kernel(tmp_path, monkeypatch,
                                                capsys):
     """A Mosaic compile/runtime failure must degrade to the XLA kernel, not
