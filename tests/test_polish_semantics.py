@@ -57,6 +57,30 @@ def test_include_unpolished(tmp_path):
     assert res[1][1] == t1  # orphan target passes through unmodified
 
 
+def test_no_trimming_keeps_low_coverage_ends(tmp_path):
+    """--no-trimming analogue: TGS trim off must never shorten consensus
+    below the trimmed variant (reference: src/window.cpp:125-146 gated by
+    the trim flag, src/main.cpp:24)."""
+    import os
+
+    from tests.conftest import DATA
+    if not os.path.isdir(DATA):
+        import pytest
+        pytest.skip("lambda data unavailable")
+
+    def run(trim):
+        p = racon_tpu.CpuPolisher(DATA + "sample_reads.fastq.gz",
+                                  DATA + "sample_overlaps.sam.gz",
+                                  DATA + "sample_layout.fasta.gz",
+                                  trim=trim, match=5, mismatch=-4, gap=-8)
+        p.initialize()
+        return p.polish(True)
+
+    trimmed = run(True)[0][1]
+    untrimmed = run(False)[0][1]
+    assert len(untrimmed) > len(trimmed)
+
+
 def test_device_aligner_phase_opt_in(tmp_path, monkeypatch):
     """RACON_TPU_DEVICE_ALIGNER=1 serves PAF overlaps on the device
     aligner; result equals the host-aligned run."""
