@@ -53,11 +53,13 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
     G = int(cfg.gap)
     KEY_INF = 3.0e38
 
+    VSLOT = 15  # pred-slot sentinel meaning "virtual start row"
+
     def kernel(bb_len_ref, n_layers_ref, lens_ref, begins_ref, ends_ref,
                bb_ref, bbw_ref, seqs_ref, ws_ref,
                cons_base_ref, cons_cov_ref, cons_len_ref, failed_ref,
                n_nodes_ref,
-               H, base, key, cov, order, in_src, in_w, pos_node, nkey,
+               H, MV, base, key, cov, order, in_src, in_w, pos_node, nkey,
                runrem, score, pred, revbuf, has_out, seq_scr, w_scr):
         lane_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
         lane_lp = jax.lax.broadcasted_iota(jnp.int32, (1, LP), 1)
@@ -121,32 +123,45 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             seqm1 = pltpu.roll(seqv, 1, 1)
 
             # ---- DP over subgraph nodes in rank order ---------------------
+            # Per-cell move records (2 bits move + pred slot, VSLOT =
+            # virtual) land in MV so the traceback is one load per step.
             def dp_body(r, _):
                 u = order[0, r]
                 ub = base[0, u]
 
                 def pred_scan(e, c):
-                    P, any_valid = c
+                    P, Pslot, any_valid = c
                     src = in_src[e, u]
                     ok = (src >= 0) & (key[0, jnp.maximum(src, 0)] >= lo)
                     prow = H[pl.ds(jnp.maximum(src, 0) + 1, 1), :]
-                    Pn = jnp.where(ok, jnp.maximum(P, prow), P)
+                    better = ok & (prow > P)  # strict: first max slot wins
+                    P = jnp.where(better, prow, P)
+                    Pslot = jnp.where(better, e, Pslot)
 
                     @pl.when(ok)
                     def _():
                         has_out[0, jnp.maximum(src, 0)] = 1
-                    return (Pn, any_valid | ok)
+                    return (P, Pslot, any_valid | ok)
 
                 P0 = jnp.full((1, LP), NEG, jnp.int32)
-                P, any_valid = jax.lax.fori_loop(0, E, pred_scan,
-                                                 (P0, jnp.bool_(False)))
+                S0 = jnp.full((1, LP), VSLOT, jnp.int32)
+                P, Pslot, any_valid = jax.lax.fori_loop(
+                    0, E, pred_scan, (P0, S0, jnp.bool_(False)))
                 P = jnp.where(any_valid, P, H[pl.ds(0, 1), :])
+                Pslot = jnp.where(any_valid, Pslot, VSLOT)
 
                 scvec = jnp.where(seqm1 == ub, M, X)
                 Psh = jnp.where(lane_lp >= 1, pltpu.roll(P, 1, 1), NEG)
-                V = jnp.maximum(Psh + scvec, P + G)
+                Ssh = jnp.where(lane_lp >= 1, pltpu.roll(Pslot, 1, 1), VSLOT)
+                diag = Psh + scvec
+                up = P + G
+                choose_diag = diag >= up  # host priority: diag before up
+                V = jnp.where(choose_diag, diag, up)
+                vmove = jnp.where(choose_diag, 4 * Ssh, 1 + 4 * Pslot)
                 row = cummax_lanes(V - gvec) + gvec
+                mv = jnp.where(row > V, 2, vmove)  # left only if strictly better
                 H[pl.ds(u + 1, 1), :] = row
+                MV[pl.ds(u + 1, 1), :] = mv.astype(jnp.int8)
                 return 0
 
             jax.lax.fori_loop(r_lo, r_hi, dp_body, 0)
@@ -176,41 +191,23 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 u, j, steps, ok = c
                 at_virtual = u == -1
                 uc = jnp.maximum(u, 0)
-                cur = H[uc + 1, j]
-                ub = base[0, uc]
                 jm1 = jnp.maximum(j - 1, 0)
-                sc = jnp.where(seq_scr[0, jm1] == ub, M, X)
+                mv = jnp.where(at_virtual, 2,
+                               MV[uc + 1, j].astype(jnp.int32))
+                move = mv % 4
+                slot = mv // 4
+                slot_c = jnp.minimum(slot, E - 1)
+                prd = jnp.where(slot == VSLOT, -1, in_src[slot_c, uc])
 
-                def slot_scan(e, c2):
-                    dfound, dpred, ufound, upred, anyv = c2
-                    src = in_src[e, uc]
-                    ok2 = (src >= 0) & (key[0, jnp.maximum(src, 0)] >= lo)
-                    hrow = jnp.maximum(src, 0) + 1
-                    dhit = ok2 & (j > 0) & (H[hrow, jm1] + sc == cur)
-                    uhit = ok2 & (H[hrow, j] + G == cur)
-                    dpred = jnp.where(dhit & ~dfound, src, dpred)
-                    dfound = dfound | dhit
-                    upred = jnp.where(uhit & ~ufound, src, upred)
-                    ufound = ufound | uhit
-                    return (dfound, dpred, ufound, upred, anyv | ok2)
+                take_diag = ~at_virtual & (move == 0)
+                take_up = ~at_virtual & (move == 1)
 
-                dfound, dpred, ufound, upred, anyv = jax.lax.fori_loop(
-                    0, E, slot_scan,
-                    (jnp.bool_(False), jnp.int32(-1), jnp.bool_(False),
-                     jnp.int32(-1), jnp.bool_(False)))
-
-                dvirt = ~anyv & (j > 0) & (H[0, jm1] + sc == cur)
-                uvirt = ~anyv & (H[0, j] + G == cur)
-                any_diag = (dfound | dvirt) & ~at_virtual
-                any_up = (ufound | uvirt) & ~at_virtual & ~any_diag
-
-                @pl.when(any_diag)
+                @pl.when(take_diag)
                 def _():
                     pos_node[0, jm1] = u
 
-                new_u = jnp.where(any_diag, dpred,
-                                  jnp.where(any_up, upred, u))
-                new_j = jnp.where(any_up, j, j - 1)
+                new_u = jnp.where(take_diag | take_up, prd, u)
+                new_j = jnp.where(take_up, j, j - 1)
                 return (new_u, new_j, steps + 1, ok)
 
             fu, fj, _, _ = jax.lax.while_loop(
@@ -437,6 +434,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             ],
             scratch_shapes=[
                 pltpu.VMEM((N + 1, LP), jnp.int32),    # H
+                pltpu.VMEM((N + 1, LP), jnp.int8),     # MV (move records)
                 pltpu.VMEM((1, N), jnp.int32),         # base
                 pltpu.VMEM((1, N), jnp.float32),       # key
                 pltpu.VMEM((1, N), jnp.int32),         # cov

@@ -1,0 +1,25 @@
+"""Scale smoke: a simulated 50 kb ONT workload polishes end-to-end with a
+substantial error reduction (the bench.py workload shape, small)."""
+
+import racon_tpu
+from racon_tpu import native
+from racon_tpu.tools import simulate
+
+
+def test_simulated_workload_polishes(tmp_path):
+    paths = simulate.generate(str(tmp_path), mbp=0.05, coverage=20, seed=7)
+    genome = b"".join(l.strip().encode() for l in open(paths["genome"])
+                      if not l.startswith(">"))
+    draft = b"".join(l.strip().encode() for l in open(paths["draft"])
+                     if not l.startswith(">"))
+    draft_ed = native.edit_distance(draft, genome)
+    assert draft_ed > 200  # ~1% draft error
+
+    p = racon_tpu.CpuPolisher(paths["reads"], paths["overlaps"],
+                              paths["draft"], window_length=500,
+                              match=5, mismatch=-4, gap=-8)
+    p.initialize()
+    res = p.polish(True)
+    assert len(res) == 1
+    polished_ed = native.edit_distance(res[0][1].encode(), genome)
+    assert polished_ed < draft_ed / 4, (draft_ed, polished_ed)
