@@ -59,8 +59,9 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                bb_ref, bbw_ref, seqs_ref, ws_ref,
                cons_base_ref, cons_cov_ref, cons_len_ref, failed_ref,
                n_nodes_ref,
-               H, MV, base, key, cov, order, in_src, in_w, pos_node, nkey,
-               runrem, score, pred, revbuf, has_out, seq_scr, w_scr):
+               H, MV, base, key, cov, order, in_src, in_w, in_cnt,
+               pos_node, nkey, runrem, score, pred, revbuf, has_out,
+               seq_scr, w_scr):
         lane_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
         lane_lp = jax.lax.broadcasted_iota(jnp.int32, (1, LP), 1)
         gvec = lane_lp * G
@@ -84,6 +85,9 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
         in_w[:] = jnp.zeros((E, N), jnp.int32)
         in_w[0:1, :] = jnp.where(chain,
                                  pltpu.roll(bbw_pad, 1, 1) + bbw_pad, 0)
+        # edge slots fill contiguously from 0, so in_cnt doubles as "first
+        # empty slot" and bounds every per-node slot loop to the true degree
+        in_cnt[:] = jnp.where(chain, 1, 0)
         H[0:1, :] = gvec
 
         def cummax_lanes(x):
@@ -132,7 +136,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 def pred_scan(e, c):
                     P, Pslot, any_valid = c
                     src = in_src[e, u]
-                    ok = (src >= 0) & (key[0, jnp.maximum(src, 0)] >= lo)
+                    ok = key[0, jnp.maximum(src, 0)] >= lo
                     prow = H[pl.ds(jnp.maximum(src, 0) + 1, 1), :]
                     better = ok & (prow > P)  # strict: first max slot wins
                     P = jnp.where(better, prow, P)
@@ -146,7 +150,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 P0 = jnp.full((1, LP), NEG, jnp.int32)
                 S0 = jnp.full((1, LP), VSLOT, jnp.int32)
                 P, Pslot, any_valid = jax.lax.fori_loop(
-                    0, E, pred_scan, (P0, S0, jnp.bool_(False)))
+                    0, in_cnt[0, u], pred_scan, (P0, S0, jnp.bool_(False)))
                 P = jnp.where(any_valid, P, H[pl.ds(0, 1), :])
                 Pslot = jnp.where(any_valid, Pslot, VSLOT)
 
@@ -282,16 +286,15 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 has_prev = touch & (prev >= 0)
 
                 def eslot_scan(e, c2):
-                    same_slot, empty_slot = c2
+                    same_slot = c2
                     src = in_src[e, nid]
-                    same_slot = jnp.where((src == prev) & (same_slot < 0), e,
-                                          same_slot)
-                    empty_slot = jnp.where((src == -1) & (empty_slot < 0), e,
-                                           empty_slot)
-                    return (same_slot, empty_slot)
+                    return jnp.where((src == prev) & (same_slot < 0), e,
+                                     same_slot)
 
-                same_slot, empty_slot = jax.lax.fori_loop(
-                    0, E, eslot_scan, (jnp.int32(-1), jnp.int32(-1)))
+                cnt = in_cnt[0, nid]
+                same_slot = jax.lax.fori_loop(
+                    0, cnt, eslot_scan, jnp.int32(-1))
+                empty_slot = jnp.where(cnt < E, cnt, -1)
                 ew = prev_w + wj
 
                 @pl.when(has_prev & (same_slot >= 0))
@@ -302,6 +305,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 def _():
                     in_src[empty_slot, nid] = prev
                     in_w[empty_slot, nid] = ew
+                    in_cnt[0, nid] = cnt + 1
 
                 failed = failed | (has_prev & (same_slot < 0) &
                                    (empty_slot < 0))
@@ -329,16 +333,15 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             def slot_scan(e, c2):
                 bw, bs, bp = c2
                 src = in_src[e, u]
-                ok = src >= 0
-                w = jnp.where(ok, in_w[e, u], NEG)
-                s = jnp.where(ok, score[0, jnp.maximum(src, 0)], NEG)
-                better = ok & ((w > bw) | ((w == bw) & (s > bs)))
+                w = in_w[e, u]
+                s = score[0, jnp.maximum(src, 0)]
+                better = (w > bw) | ((w == bw) & (s > bs))
                 return (jnp.where(better, w, bw), jnp.where(better, s, bs),
                         jnp.where(better, src, bp))
 
             bw, bs, bp = jax.lax.fori_loop(
-                0, E, slot_scan, (jnp.int32(NEG), jnp.int32(NEG),
-                                  jnp.int32(-1)))
+                0, in_cnt[0, u], slot_scan, (jnp.int32(NEG), jnp.int32(NEG),
+                                             jnp.int32(-1)))
             s = jnp.where(bp >= 0, bw + bs, 0)
             score[0, u] = s
             pred[0, u] = bp
@@ -441,6 +444,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 pltpu.VMEM((1, N), jnp.int32),         # order
                 pltpu.VMEM((E, N), jnp.int32),         # in_src
                 pltpu.VMEM((E, N), jnp.int32),         # in_w
+                pltpu.VMEM((1, N), jnp.int32),         # in_cnt
                 pltpu.VMEM((1, L), jnp.int32),         # pos_node
                 pltpu.VMEM((1, L), jnp.float32),       # nkey
                 pltpu.VMEM((1, L), jnp.int32),         # runrem
