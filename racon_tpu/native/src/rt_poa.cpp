@@ -237,11 +237,10 @@ std::string PoaGraph::generate_consensus(
   for (int32_t v : path) {
     consensus += nodes_[v].base;
     if (coverages) {
-      uint32_t cov = 0;
-      for (int32_t m : col_members_[nodes_[v].col]) {
-        cov += nodes_[m].coverage;
-      }
-      coverages->push_back(cov);
+      // Node coverage (paths through the chosen node itself) drives the
+      // trim rule; measured better end-trimming than column-sum coverage
+      // on every golden scenario.
+      coverages->push_back(nodes_[v].coverage);
     }
   }
   return consensus;

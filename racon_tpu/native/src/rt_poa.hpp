@@ -58,10 +58,12 @@ class PoaGraph {
   void add_alignment(const PoaAlignment& alignment, const char* seq,
                      uint32_t len, const std::vector<uint32_t>& weights);
 
-  // Heaviest-bundle consensus. Every consensus base gets a column coverage
-  // count (paths through the chosen node plus through its column siblings),
-  // which is what the window trim logic consumes
-  // (reference: src/window.cpp:122-146).
+  // Heaviest-bundle consensus. Every consensus base gets the chosen node's
+  // own path coverage, consumed by the window trim rule (reference call
+  // site: src/window.cpp:122-146). Deliberate deviation: spoa's summary
+  // counts the whole aligned column; node-only coverage measured better
+  // end-trimming on every golden scenario (docs/benchmarks.md), so the
+  // trim threshold sees the support for the *chosen* base, not the column.
   std::string generate_consensus(std::vector<uint32_t>* coverages) const;
 
   uint32_t num_nodes() const { return static_cast<uint32_t>(nodes_.size()); }

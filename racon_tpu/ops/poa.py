@@ -3,7 +3,7 @@
 TPU-native replacement for the reference's per-window SPOA consensus
 (/root/reference/src/window.cpp:65-149) and its CUDA batch analogue
 (/root/reference/src/cuda/cudabatch.cpp): one jitted program consumes a
-padded batch of windows and emits consensus strings + column coverages.
+padded batch of windows and emits consensus strings + per-node coverages.
 
 Design (mirrors the host engine in racon_tpu/native/src/rt_poa.cpp, which is
 the correctness oracle):
@@ -370,14 +370,11 @@ def _consensus(cfg: PoaConfig, g: Graph):
     path, cnt = jax.lax.while_loop(
         fcond, fbody, (summit, path, cnt_b, jnp.bool_(True)))[1:3]
 
-    # Column coverage per path node: sum cov over same-key nodes.
+    # Node coverage per path node (trim-rule input; matches the host
+    # oracle's semantics).
     path_c = jnp.maximum(path, 0)
-    pk = g.key[path_c]                                # [N]
-    eq = (pk[:, None] == g.key[None, :]) & jnp.isfinite(g.key)[None, :]
-    col_cov = (eq * g.cov[None, :]).sum(axis=1).astype(jnp.int32)
-
     cons_base = jnp.where(path >= 0, g.base[path_c], -1)
-    cons_cov = jnp.where(path >= 0, col_cov, 0)
+    cons_cov = jnp.where(path >= 0, g.cov[path_c], 0)
     return cons_base, cons_cov, cnt
 
 

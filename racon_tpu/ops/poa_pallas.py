@@ -366,14 +366,9 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
         cons_base_ref[:] = jnp.full((1, N), -1, jnp.int32)
         cons_cov_ref[:] = jnp.zeros((1, N), jnp.int32)
 
-        covv = cov[:]
-        keysv = key[:]
-
         def emit(i, u):
             cons_base_ref[0, i] = base[0, u]
-            ck = key[0, u]
-            colcov = jnp.sum(jnp.where(keysv == ck, covv, 0)).astype(jnp.int32)
-            cons_cov_ref[0, i] = colcov
+            cons_cov_ref[0, i] = cov[0, u]
 
         def flip_body(i, _):
             emit(i, revbuf[0, cnt_b - 1 - i])
