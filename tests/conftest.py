@@ -8,19 +8,11 @@ multi-chip path; see __graft_entry__.py). Must be set before jax imports.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
-
-# The axon TPU plugin in this image ignores the JAX_PLATFORMS env var; the
-# config knob still wins if set before backend init.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _force_cpu  # noqa: E402  (imports numpy only)
+
+_force_cpu(8)
 
 import gzip  # noqa: E402
 
