@@ -66,6 +66,14 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
                         trim: bool, progress: bool = False) -> dict:
     """Device consensus for every eligible window; host for the rest.
 
+    Streaming: a cheap metadata pass (window_info — no bases copied) sizes
+    the geometry and buckets windows by depth; window bases are exported
+    chunk-by-chunk at pack time, so driver memory is O(batch). Packing of
+    chunk N+1 overlaps device execution of chunk N through JAX async
+    dispatch — the analogue of the reference's greedy batch fill running
+    concurrently with kernel execution
+    (/root/reference/src/cuda/cudapolisher.cpp:83-145).
+
     Returns stats {device:…, host_fallback:…, backbone:…}.
     """
     n = pipeline.num_windows()
@@ -74,34 +82,20 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
     fallback: List[int] = []
     window_length = 0
 
-    # First pass: export everything and find the batch geometry (the layer
-    # length cap depends on the final config, which depends on the largest
-    # backbone).
-    exports = []
+    # Metadata pass: geometry + depth buckets, no layer bytes touched.
+    jobs = []          # (window_idx, estimated depth)
     for i in range(n):
-        wx = pipeline.export_window(i)
-        window_length = max(window_length, len(wx.backbone))
-        exports.append(wx)
-
-    max_len = make_config(max(window_length, 1), DEPTH_BUCKETS[0], match,
-                          mismatch, gap).max_len
-
-    jobs = []          # (window_idx, export, kept layer indices)
-    for i, wx in enumerate(exports):
-        k = len(wx.lens)
+        n_seqs, bb_len, _rank, _is_tgs, _bytes, _tid = pipeline.window_info(i)
+        window_length = max(window_length, bb_len)
+        k = n_seqs - 1
         if k < 2:
             # <3 sequences incl. backbone: backbone passthrough
             # (reference: src/window.cpp:68-71)
+            wx = pipeline.export_window(i)
             pipeline.set_consensus(i, wx.backbone.tobytes(), False)
             stats["backbone"] += 1
             continue
-        keep = [j for j in range(k) if 0 < wx.lens[j] <= max_len]
-        if len(keep) < len(wx.lens[:DEPTH_CAP]) and len(keep) < 2:
-            # device can't represent enough of this window: host it
-            fallback.append(i)
-            continue
-        keep = keep[:DEPTH_CAP]
-        jobs.append((i, wx, keep))
+        jobs.append((i, min(k, DEPTH_CAP)))
 
     if jobs:
         from ..parallel.mesh import divisible_batch
@@ -109,52 +103,93 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
         n_dev = _n_devices()
         B = divisible_batch(n_dev, _batch_size())
         use_pallas = _use_pallas()
-        # Bucket by depth to bound padding waste.
+        # Bucket by depth to bound padding waste. Layers dropped at pack
+        # time (oversized/empty) only shrink a window's true depth, so a
+        # window always fits the bucket its estimate chose.
         buckets = {}
-        for job in jobs:
-            depth = len(job[2])
+        for i, depth in jobs:
             bucket = next(b for b in DEPTH_BUCKETS if depth <= b)
-            buckets.setdefault(bucket, []).append(job)
+            buckets.setdefault(bucket, []).append((i, depth))
 
+        pending = None  # (chunk, packed, outs, cfg, use_pallas) in flight
+        dead_geoms = set()  # configs whose pallas kernel failed at runtime
         for depth_bucket, bucket_jobs in sorted(buckets.items()):
             cfg = make_config(max(window_length, 1), depth_bucket, match,
                               mismatch, gap)
-            bucket_pallas = use_pallas
+            # Large window geometries (e.g. -w 1000) overflow the fused
+            # kernel's VMEM budget; the flag must flip HERE so _submit and
+            # _unpack agree with the kernel _build_kernel actually returns.
+            bucket_pallas = use_pallas and _fits_vmem(cfg)
             kernel = _build_kernel(cfg, B, bucket_pallas)
             # Sequential loops run lock-step across the batch, so keep
             # batches depth-homogeneous.
-            bucket_jobs.sort(key=lambda job: len(job[2]))
+            bucket_jobs.sort(key=lambda job: job[1])
             for off in range(0, len(bucket_jobs), B):
-                chunk = bucket_jobs[off:off + B]
+                if bucket_pallas and cfg in dead_geoms:
+                    # an earlier chunk of this geometry failed at drain
+                    # time: stop dispatching through the broken kernel
+                    bucket_pallas = False
+                    kernel = _build_kernel(cfg, B, False)
+                idxs = [i for i, _ in bucket_jobs[off:off + B]]
                 pad = B if (bucket_pallas or n_dev > 1) else None
+                chunk = _export_chunk(pipeline, idxs, cfg, fallback)
+                if not chunk:
+                    continue
+                packed = _pack(chunk, cfg, pad)
                 try:
-                    _run_chunk(pipeline, kernel, cfg, chunk, trim, stats,
-                               fallback, use_pallas=bucket_pallas,
-                               pad_to=pad)
+                    outs = _submit(kernel, packed, bucket_pallas)
                 except Exception as e:  # noqa: BLE001
                     if not bucket_pallas:
                         raise
-                    # Mosaic compile/runtime failure: degrade to the XLA
-                    # kernel for the rest of this geometry (same fallback
-                    # philosophy as the per-window host fallback).
-                    print("[racon_tpu::poa] WARNING: pallas kernel failed "
-                          f"({type(e).__name__}: {e}); falling back to the "
-                          "XLA kernel", file=sys.stderr)
-                    bucket_pallas = False
-                    kernel = _build_kernel(cfg, B, bucket_pallas)
-                    pad = B if n_dev > 1 else None
-                    _run_chunk(pipeline, kernel, cfg, chunk, trim, stats,
-                               fallback, use_pallas=bucket_pallas,
-                               pad_to=pad)
+                    dead_geoms.add(cfg)
+                    bucket_pallas, kernel = _degrade(e, cfg, B)
+                    outs = _submit(kernel, packed, bucket_pallas)
+                if pending is not None:
+                    _drain(pipeline, pending, trim, stats, fallback, B,
+                           dead_geoms)
+                pending = (chunk, packed, outs, cfg, bucket_pallas)
             if progress:
                 print(f"[racon_tpu::poa] bucket depth<={depth_bucket}: "
                       f"{len(bucket_jobs)} windows", file=sys.stderr)
+        if pending is not None:
+            _drain(pipeline, pending, trim, stats, fallback, B, dead_geoms)
 
     for i in fallback:
         pipeline.consensus_cpu_one(i)
         stats["host_fallback"] += 1
 
     return stats
+
+
+def _degrade(e, cfg, B):
+    """Mosaic compile/runtime failure: fall back to the XLA kernel for the
+    rest of this geometry (same philosophy as the per-window host
+    fallback)."""
+    print("[racon_tpu::poa] WARNING: pallas kernel failed "
+          f"({type(e).__name__}: {e}); falling back to the XLA kernel",
+          file=sys.stderr)
+    return False, _build_kernel(cfg, B, False)
+
+
+def _drain(pipeline, pending, trim, stats, fallback, B, dead_geoms):
+    """Block on an in-flight chunk's device results and install them.
+
+    If the pallas kernel failed at runtime (error surfaces at the blocking
+    transfer), re-run the chunk through the XLA kernel — the packed arrays
+    are still on hand, so no re-export is needed — and mark the geometry
+    dead so the bucket loop stops dispatching through the broken kernel.
+    """
+    chunk, packed, outs, cfg, was_pallas = pending
+    try:
+        results = _unpack(outs, was_pallas)
+    except Exception as e:  # noqa: BLE001
+        if not was_pallas:
+            raise
+        dead_geoms.add(cfg)
+        _, kernel = _degrade(e, cfg, B)
+        outs = _submit(kernel, packed, False)
+        results = _unpack(outs, False)
+    _install(pipeline, chunk, results, trim, stats, fallback)
 
 
 def _use_pallas() -> bool:
@@ -170,13 +205,14 @@ def _n_devices() -> int:
     return len(jax.devices())
 
 
-def _fits_vmem(cfg, budget_bytes: int = 12 << 20) -> bool:
+def _fits_vmem(cfg, budget_bytes: int = 14 << 20) -> bool:
     """Whether the fused Pallas kernel's VMEM scratch fits the core budget."""
     lp = (cfg.max_len + 1 + 127) // 128 * 128
     h = (cfg.max_nodes + 1) * lp * 4
+    mv = (cfg.max_nodes + 1) * lp * 4   # move records, i32 (Mosaic tiling)
     layers = 2 * cfg.depth * cfg.max_len * 4
     graph = cfg.max_nodes * (4 * 4 + 2 * cfg.max_edges * 4)
-    return h + layers + graph < budget_bytes
+    return h + mv + layers + graph < budget_bytes
 
 
 def _build_kernel(cfg, B, use_pallas):
@@ -189,10 +225,8 @@ def _build_kernel(cfg, B, use_pallas):
     import jax
 
     n_dev = _n_devices()
-    if use_pallas and not _fits_vmem(cfg):
-        # Large window geometries (e.g. -w 1000) overflow the ~16 MB/core
-        # VMEM budget of the fused kernel; use the XLA-scheduled variant.
-        use_pallas = False
+    assert not (use_pallas and not _fits_vmem(cfg)), (
+        "caller must check _fits_vmem before requesting the pallas kernel")
     if use_pallas:
         from . import poa_pallas
         interp = jax.devices()[0].platform != "tpu"
@@ -216,8 +250,25 @@ def _build_kernel(cfg, B, use_pallas):
     return shard_batch_kernel(kernel, device_mesh(), 9)
 
 
-def _run_chunk(pipeline, kernel, cfg, chunk, trim, stats, fallback,
-               use_pallas=False, pad_to=None):
+def _export_chunk(pipeline, idxs, cfg, fallback):
+    """Export window bases for one chunk; apply per-layer admission.
+
+    Returns [(window_idx, export, kept layer indices)] — windows the device
+    can't represent go straight to the host fallback list.
+    """
+    chunk = []
+    for i in idxs:
+        wx = pipeline.export_window(i)
+        k = len(wx.lens)
+        keep = [j for j in range(k) if 0 < wx.lens[j] <= cfg.max_len]
+        if len(keep) < len(wx.lens[:DEPTH_CAP]) and len(keep) < 2:
+            fallback.append(i)
+            continue
+        chunk.append((i, wx, keep[:DEPTH_CAP]))
+    return chunk
+
+
+def _pack(chunk, cfg, pad_to=None):
     B = pad_to if pad_to is not None else len(chunk)
     bb = np.zeros((B, cfg.max_backbone), dtype=np.uint8)
     bbw = np.zeros((B, cfg.max_backbone), dtype=np.int32)
@@ -243,20 +294,34 @@ def _run_chunk(pipeline, kernel, cfg, chunk, trim, stats, fallback,
             lens[bi, li] = ll
             begins[bi, li] = wx.begins[j]
             ends[bi, li] = wx.ends[j]
+    return (bb, bbw, bb_len, n_layers, seqs, ws, lens, begins, ends)
 
+
+def _submit(kernel, packed, use_pallas):
+    """Dispatch one packed chunk; returns device futures (async)."""
+    bb, bbw, bb_len, n_layers, seqs, ws, lens, begins, ends = packed
     if use_pallas:
-        cb, cc, cl, fl, _ = kernel(
-            bb_len[:, None], n_layers[:, None], lens, begins, ends,
-            bb.astype(np.int32), bbw, seqs.astype(np.int32), ws)
-        cons_base = np.asarray(cb)
-        cons_cov = np.asarray(cc)
-        cons_len = np.asarray(cl)[:, 0]
-        failed = np.asarray(fl)[:, 0]
-    else:
-        cons_base, cons_cov, cons_len, failed, _ = (
-            np.asarray(x) for x in kernel(bb, bbw, bb_len, n_layers, seqs,
-                                          ws, lens, begins, ends))
+        return kernel(bb_len[:, None], n_layers[:, None], lens, begins,
+                      ends, bb.astype(np.int32), bbw, seqs.astype(np.int32),
+                      ws)
+    return kernel(bb, bbw, bb_len, n_layers, seqs, ws, lens, begins, ends)
 
+
+def _unpack(outs, use_pallas):
+    """Block on device futures; normalize to host arrays."""
+    cb, cc, cl, fl = outs[0], outs[1], outs[2], outs[3]
+    cons_base = np.asarray(cb)
+    cons_cov = np.asarray(cc)
+    cons_len = np.asarray(cl)
+    failed = np.asarray(fl)
+    if use_pallas:
+        cons_len = cons_len[:, 0]
+        failed = failed[:, 0]
+    return cons_base, cons_cov, cons_len, failed
+
+
+def _install(pipeline, chunk, results, trim, stats, fallback):
+    cons_base, cons_cov, cons_len, failed = results
     for bi, (i, wx, keep) in enumerate(chunk):
         if failed[bi]:
             fallback.append(i)
@@ -267,7 +332,7 @@ def _run_chunk(pipeline, kernel, cfg, chunk, trim, stats, fallback,
         cov = cons_cov[bi, :cl]
         out = np.asarray(codes)
         if wx.is_tgs and trim:
-            keep_mask_len = len(keep) + 1  # incorporated sequences incl. backbone
+            keep_mask_len = len(keep) + 1  # incorporated seqs incl. backbone
             kept_codes = tgs_trim(out, np.asarray(cov), keep_mask_len)
         else:
             kept_codes = out

@@ -64,21 +64,60 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                seq_scr, w_scr):
         lane_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
         lane_lp = jax.lax.broadcasted_iota(jnp.int32, (1, LP), 1)
+        lane_l = jax.lax.broadcasted_iota(jnp.int32, (1, L), 1)
+        en_rows = jax.lax.broadcasted_iota(jnp.int32, (E, N), 0)
+        en_cols = jax.lax.broadcasted_iota(jnp.int32, (E, N), 1)
         gvec = lane_lp * G
 
-        bb_len = bb_len_ref[0, 0]
-        n_layers = n_layers_ref[0, 0]
+        # Mosaic cannot store scalars to VMEM; every scalar store becomes a
+        # masked full-row read-modify-write (the rows are a handful of
+        # vregs, so this costs a few VPU ops per store).
+        def rmw1(ref, iota, idx, val):
+            ref[:] = jnp.where(iota == idx, val, ref[:])
+
+        def rmw2(ref, row, col, val):
+            ref[:] = jnp.where((en_rows == row) & (en_cols == col), val,
+                               ref[:])
+
+        # ... and every dynamic-lane scalar load becomes a masked reduction
+        # (dynamic lane offsets must be 128-aligned on Mosaic; dynamic
+        # sublane offsets are fine, which the H/MV row accesses rely on).
+        def load1(ref, iota, idx):
+            row = ref[:]
+            return jnp.sum(jnp.where(iota == idx, row,
+                                     jnp.zeros_like(row)))
+
+        def load2(ref, row, col):
+            v = ref[:]
+            return jnp.sum(jnp.where((en_rows == row) & (en_cols == col), v,
+                                     jnp.zeros_like(v)))
+
+        def load_lane(rowvec, iota, idx):
+            return jnp.sum(jnp.where(iota == idx, rowvec,
+                                     jnp.zeros_like(rowvec)))
+
+        bb_len = bb_len_ref[0, 0, 0]
+        n_layers = n_layers_ref[0, 0, 0]
+
+        def padcat(row, width, fill):
+            # static right-pad to `width` lanes (Mosaic has no scatter;
+            # concatenate lowers cleanly)
+            w = row.shape[1]
+            if w == width:
+                return row
+            return jnp.concatenate(
+                [row, jnp.full((1, width - w), fill, row.dtype)], axis=1)
 
         # ---- graph init from the backbone chain --------------------------
-        bbrow = bb_ref[:]                                   # (1, BB)
-        bbpad = jnp.full((1, N), -1, jnp.int32).at[:, :BB].set(bbrow)
+        bbrow = bb_ref[0]                                   # (1, BB)
+        bbpad = padcat(bbrow, N, -1)
         used0 = lane_n < bb_len
         base[:] = jnp.where(used0, bbpad, -1)
         key[:] = jnp.where(used0, lane_n.astype(jnp.float32), KEY_INF)
         cov[:] = jnp.where(used0, 1, 0)
         order[:] = lane_n
-        bbw_row = bbw_ref[:]
-        bbw_pad = jnp.zeros((1, N), jnp.int32).at[:, :BB].set(bbw_row)
+        bbw_row = bbw_ref[0]
+        bbw_pad = padcat(bbw_row, N, 0)
         chain = (lane_n > 0) & used0
         in_src[:] = jnp.full((E, N), -1, jnp.int32)
         in_src[0:1, :] = jnp.where(chain, lane_n - 1, -1)
@@ -101,9 +140,9 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
         # ---- one layer ----------------------------------------------------
         def do_layer(li, carry):
             n, failed = carry
-            Ln = lens_ref[0, li]
-            begin = begins_ref[0, li]
-            end = ends_ref[0, li]
+            Ln = lens_ref[0, 0, li]
+            begin = begins_ref[0, 0, li]
+            end = ends_ref[0, 0, li]
 
             # full-graph rule (reference: src/window.cpp:88-97)
             offset = (0.01 * bb_len.astype(jnp.float32)).astype(jnp.int32)
@@ -112,10 +151,8 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             hi = jnp.where(full, jnp.float32(3.0e38), end.astype(jnp.float32))
 
             # stage the layer into scratch
-            seq_scr[:] = jnp.full((1, LP), 255, jnp.int32).at[:, :L].set(
-                seqs_ref[0, pl.ds(li, 1), :])
-            w_scr[:] = jnp.zeros((1, LP), jnp.int32).at[:, :L].set(
-                ws_ref[0, pl.ds(li, 1), :])
+            seq_scr[:] = padcat(seqs_ref[0, pl.ds(li, 1), :], LP, 255)
+            w_scr[:] = padcat(ws_ref[0, pl.ds(li, 1), :], LP, 0)
 
             keys = key[:]
             r_lo = jnp.sum(jnp.where(keys < lo, 1, 0)).astype(jnp.int32)
@@ -130,13 +167,13 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             # Per-cell move records (2 bits move + pred slot, VSLOT =
             # virtual) land in MV so the traceback is one load per step.
             def dp_body(r, _):
-                u = order[0, r]
-                ub = base[0, u]
+                u = load1(order, lane_n, r)
+                ub = load1(base, lane_n, u)
 
                 def pred_scan(e, c):
                     P, Pslot, any_valid = c
-                    src = in_src[e, u]
-                    ok = key[0, jnp.maximum(src, 0)] >= lo
+                    src = load2(in_src, e, u)
+                    ok = load1(key, lane_n, jnp.maximum(src, 0)) >= lo
                     prow = H[pl.ds(jnp.maximum(src, 0) + 1, 1), :]
                     better = ok & (prow > P)  # strict: first max slot wins
                     P = jnp.where(better, prow, P)
@@ -144,13 +181,14 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
                     @pl.when(ok)
                     def _():
-                        has_out[0, jnp.maximum(src, 0)] = 1
+                        rmw1(has_out, lane_n, jnp.maximum(src, 0), 1)
                     return (P, Pslot, any_valid | ok)
 
                 P0 = jnp.full((1, LP), NEG, jnp.int32)
                 S0 = jnp.full((1, LP), VSLOT, jnp.int32)
                 P, Pslot, any_valid = jax.lax.fori_loop(
-                    0, in_cnt[0, u], pred_scan, (P0, S0, jnp.bool_(False)))
+                    0, load1(in_cnt, lane_n, u), pred_scan,
+                    (P0, S0, jnp.bool_(False)))
                 P = jnp.where(any_valid, P, H[pl.ds(0, 1), :])
                 Pslot = jnp.where(any_valid, Pslot, VSLOT)
 
@@ -165,7 +203,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 row = cummax_lanes(V - gvec) + gvec
                 mv = jnp.where(row > V, 2, vmove)  # left only if strictly better
                 H[pl.ds(u + 1, 1), :] = row
-                MV[pl.ds(u + 1, 1), :] = mv.astype(jnp.int8)
+                MV[pl.ds(u + 1, 1), :] = mv
                 return 0
 
             jax.lax.fori_loop(r_lo, r_hi, dp_body, 0)
@@ -173,9 +211,9 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             # ---- best end node (first max in rank order) ------------------
             def end_body(r, c):
                 best_u, best_s = c
-                u = order[0, r]
-                is_end = has_out[0, u] == 0
-                s = H[u + 1, Ln]
+                u = load1(order, lane_n, r)
+                is_end = load1(has_out, lane_n, u) == 0
+                s = load_lane(H[pl.ds(u + 1, 1), :], lane_lp, Ln)
                 better = is_end & (s > best_s)
                 return (jnp.where(better, u, best_u),
                         jnp.where(better, s, best_s))
@@ -196,19 +234,19 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 at_virtual = u == -1
                 uc = jnp.maximum(u, 0)
                 jm1 = jnp.maximum(j - 1, 0)
-                mv = jnp.where(at_virtual, 2,
-                               MV[uc + 1, j].astype(jnp.int32))
+                mv_loaded = load_lane(MV[pl.ds(uc + 1, 1), :], lane_lp, j)
+                mv = jnp.where(at_virtual, 2, mv_loaded)
                 move = mv % 4
                 slot = mv // 4
                 slot_c = jnp.minimum(slot, E - 1)
-                prd = jnp.where(slot == VSLOT, -1, in_src[slot_c, uc])
+                prd = jnp.where(slot == VSLOT, -1, load2(in_src, slot_c, uc))
 
                 take_diag = ~at_virtual & (move == 0)
                 take_up = ~at_virtual & (move == 1)
 
                 @pl.when(take_diag)
                 def _():
-                    pos_node[0, jm1] = u
+                    rmw1(pos_node, lane_l, jm1, u)
 
                 new_u = jnp.where(take_diag | take_up, prd, u)
                 new_j = jnp.where(take_up, j, j - 1)
@@ -223,12 +261,12 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             def back_body(i, c):
                 nk, run = c
                 j = Ln - 1 - i
-                pn = pos_node[0, j]
+                pn = load1(pos_node, lane_l, j)
                 m = pn >= 0
-                nk = jnp.where(m, key[0, jnp.maximum(pn, 0)], nk)
+                nk = jnp.where(m, load1(key, lane_n, jnp.maximum(pn, 0)), nk)
                 run = jnp.where(m, 0, run + 1)
-                nkey[0, j] = nk
-                runrem[0, j] = run
+                rmw1(nkey, lane_l, j, nk)
+                rmw1(runrem, lane_l, j, run)
                 return (nk, run)
 
             jax.lax.fori_loop(0, Ln, back_body,
@@ -237,19 +275,19 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             # ---- graph update ----------------------------------------------
             def upd_body(j, c):
                 n, failed, prev, prev_key, prev_w = c
-                b = seq_scr[0, j]
-                wj = w_scr[0, j]
-                pn = pos_node[0, j]
+                b = load1(seq_scr, lane_lp, j)
+                wj = load1(w_scr, lane_lp, j)
+                pn = load1(pos_node, lane_l, j)
                 is_match = pn >= 0
-                k0 = key[0, jnp.maximum(pn, 0)]
+                k0 = load1(key, lane_n, jnp.maximum(pn, 0))
 
                 keys = key[:]
                 cand = (keys == k0) & (base[:] == b)
                 has = cand.any() & is_match
                 found = jnp.min(jnp.where(cand, lane_n, N)).astype(jnp.int32)
 
-                nk = nkey[0, j]
-                run = runrem[0, j].astype(jnp.float32)
+                nk = load1(nkey, lane_l, j)
+                run = load1(runrem, lane_l, j).astype(jnp.float32)
                 hi2 = jnp.where(nk < KEY_INF, nk, prev_key + 1.0)
                 lo2 = jnp.where(prev >= 0, prev_key, hi2 - run - 1.0)
                 k_new = lo2 + (hi2 - lo2) / (run + 1.0)
@@ -265,8 +303,8 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                     # insert into sorted order: after all keys <= key_val
                     p = jnp.sum(jnp.where(keys <= key_val, 1, 0)).astype(
                         jnp.int32)
-                    base[0, nid] = b
-                    key[0, nid] = key_val
+                    rmw1(base, lane_n, nid, b)
+                    rmw1(key, lane_n, nid, key_val)
                     ordv = order[:]
                     shifted = pltpu.roll(ordv, 1, 1)
                     order[:] = jnp.where(
@@ -277,7 +315,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
                 @pl.when(touch)
                 def _():
-                    cov[0, nid] = cov[0, nid] + 1
+                    rmw1(cov, lane_n, nid, load1(cov, lane_n, nid) + 1)
 
                 n = n + jnp.where(do_new, 1, 0)
                 failed = failed | overflow
@@ -287,11 +325,11 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
                 def eslot_scan(e, c2):
                     same_slot = c2
-                    src = in_src[e, nid]
+                    src = load2(in_src, e, nid)
                     return jnp.where((src == prev) & (same_slot < 0), e,
                                      same_slot)
 
-                cnt = in_cnt[0, nid]
+                cnt = load1(in_cnt, lane_n, nid)
                 same_slot = jax.lax.fori_loop(
                     0, cnt, eslot_scan, jnp.int32(-1))
                 empty_slot = jnp.where(cnt < E, cnt, -1)
@@ -299,17 +337,18 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
                 @pl.when(has_prev & (same_slot >= 0))
                 def _():
-                    in_w[same_slot, nid] = in_w[same_slot, nid] + ew
+                    rmw2(in_w, same_slot, nid,
+                         load2(in_w, same_slot, nid) + ew)
 
                 @pl.when(has_prev & (same_slot < 0) & (empty_slot >= 0))
                 def _():
-                    in_src[empty_slot, nid] = prev
-                    in_w[empty_slot, nid] = ew
-                    in_cnt[0, nid] = cnt + 1
+                    rmw2(in_src, empty_slot, nid, prev)
+                    rmw2(in_w, empty_slot, nid, ew)
+                    rmw1(in_cnt, lane_n, nid, cnt + 1)
 
                 failed = failed | (has_prev & (same_slot < 0) &
                                    (empty_slot < 0))
-                return (n, failed, nid, key[0, nid], wj)
+                return (n, failed, nid, load1(key, lane_n, nid), wj)
 
             n, failed, _, _, _ = jax.lax.fori_loop(
                 0, Ln, upd_body,
@@ -318,7 +357,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
         def layer_loop(li, carry):
             n, failed = carry
-            run = (lens_ref[0, li] > 0) & ~failed
+            run = (lens_ref[0, 0, li] > 0) & ~failed
             return jax.lax.cond(run, lambda c: do_layer(li, c),
                                 lambda c: c, (n, failed))
 
@@ -328,23 +367,23 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
         # ---- consensus -----------------------------------------------------
         def score_body(r, c):
             best_u, best_s = c
-            u = order[0, r]
+            u = load1(order, lane_n, r)
 
             def slot_scan(e, c2):
                 bw, bs, bp = c2
-                src = in_src[e, u]
-                w = in_w[e, u]
-                s = score[0, jnp.maximum(src, 0)]
+                src = load2(in_src, e, u)
+                w = load2(in_w, e, u)
+                s = load1(score, lane_n, jnp.maximum(src, 0))
                 better = (w > bw) | ((w == bw) & (s > bs))
                 return (jnp.where(better, w, bw), jnp.where(better, s, bs),
                         jnp.where(better, src, bp))
 
             bw, bs, bp = jax.lax.fori_loop(
-                0, in_cnt[0, u], slot_scan, (jnp.int32(NEG), jnp.int32(NEG),
-                                             jnp.int32(-1)))
+                0, load1(in_cnt, lane_n, u), slot_scan,
+                (jnp.int32(NEG), jnp.int32(NEG), jnp.int32(-1)))
             s = jnp.where(bp >= 0, bw + bs, 0)
-            score[0, u] = s
-            pred[0, u] = bp
+            rmw1(score, lane_n, u, s)
+            rmw1(pred, lane_n, u, bp)
             better = s > best_s
             return (jnp.where(better, u, best_u), jnp.maximum(s, best_s))
 
@@ -358,20 +397,22 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
         def bbody(c):
             u, cnt = c
-            revbuf[0, cnt] = u
-            return (pred[0, u], cnt + 1)
+            rmw1(revbuf, lane_n, cnt, u)
+            return (load1(pred, lane_n, u), cnt + 1)
 
         _, cnt_b = jax.lax.while_loop(bcond, bbody, (summit, jnp.int32(0)))
 
-        cons_base_ref[:] = jnp.full((1, N), -1, jnp.int32)
-        cons_cov_ref[:] = jnp.zeros((1, N), jnp.int32)
+        cons_base_ref[0] = jnp.full((1, N), -1, jnp.int32)
+        cons_cov_ref[0] = jnp.zeros((1, N), jnp.int32)
 
         def emit(i, u):
-            cons_base_ref[0, i] = base[0, u]
-            cons_cov_ref[0, i] = cov[0, u]
+            cons_base_ref[0] = jnp.where(lane_n == i, load1(base, lane_n, u),
+                                         cons_base_ref[0])
+            cons_cov_ref[0] = jnp.where(lane_n == i, load1(cov, lane_n, u),
+                                        cons_cov_ref[0])
 
         def flip_body(i, _):
-            emit(i, revbuf[0, cnt_b - 1 - i])
+            emit(i, load1(revbuf, lane_n, cnt_b - 1 - i))
             return 0
 
         jax.lax.fori_loop(0, cnt_b, flip_body, 0)
@@ -403,36 +444,40 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
         _, cnt, _ = jax.lax.while_loop(
             fcond, fbody, (summit, cnt_b, jnp.bool_(True)))
 
-        cons_len_ref[0, 0] = cnt
-        failed_ref[0, 0] = failed.astype(jnp.int32)
-        n_nodes_ref[0, 0] = n
+        cons_len_ref[0, 0, 0] = cnt
+        failed_ref[0, 0, 0] = failed.astype(jnp.int32)
+        n_nodes_ref[0, 0, 0] = n
 
     def make(batch: int):
-        smem1 = lambda: pl.BlockSpec((1, 1), lambda b: (b, 0),
-                                     memory_space=pltpu.SMEM)
-        smemD = lambda: pl.BlockSpec((1, D), lambda b: (b, 0),
-                                     memory_space=pltpu.SMEM)
-        vmem2 = lambda w: pl.BlockSpec((1, w), lambda b: (b, 0),
-                                       memory_space=pltpu.VMEM)
+        # Mosaic block rules: last two block dims must tile (8,128) or equal
+        # the array dims. A leading singleton makes the grid dim the only
+        # blocked dim, so per-program blocks satisfy the rule in both SMEM
+        # (scalars) and VMEM (rows); SMEM residency stays O(D), not O(B*D).
+        smem3 = lambda w: pl.BlockSpec((1, 1, w), lambda b: (b, 0, 0),
+                                       memory_space=pltpu.SMEM)
+        vmem3w = lambda w: pl.BlockSpec((1, 1, w), lambda b: (b, 0, 0),
+                                        memory_space=pltpu.VMEM)
         vmem3 = lambda: pl.BlockSpec((1, D, L), lambda b: (b, 0, 0),
                                      memory_space=pltpu.VMEM)
 
         return pl.pallas_call(
             kernel,
             grid=(batch,),
-            in_specs=[smem1(), smem1(), smemD(), smemD(), smemD(),
-                      vmem2(BB), vmem2(BB), vmem3(), vmem3()],
-            out_specs=[vmem2(N), vmem2(N), smem1(), smem1(), smem1()],
+            in_specs=[smem3(1), smem3(1), smem3(D), smem3(D), smem3(D),
+                      vmem3w(BB), vmem3w(BB), vmem3(), vmem3()],
+            out_specs=[vmem3w(N), vmem3w(N), smem3(1), smem3(1), smem3(1)],
             out_shape=[
-                jax.ShapeDtypeStruct((batch, N), jnp.int32),
-                jax.ShapeDtypeStruct((batch, N), jnp.int32),
-                jax.ShapeDtypeStruct((batch, 1), jnp.int32),
-                jax.ShapeDtypeStruct((batch, 1), jnp.int32),
-                jax.ShapeDtypeStruct((batch, 1), jnp.int32),
+                jax.ShapeDtypeStruct((batch, 1, N), jnp.int32),
+                jax.ShapeDtypeStruct((batch, 1, N), jnp.int32),
+                jax.ShapeDtypeStruct((batch, 1, 1), jnp.int32),
+                jax.ShapeDtypeStruct((batch, 1, 1), jnp.int32),
+                jax.ShapeDtypeStruct((batch, 1, 1), jnp.int32),
             ],
             scratch_shapes=[
                 pltpu.VMEM((N + 1, LP), jnp.int32),    # H
-                pltpu.VMEM((N + 1, LP), jnp.int8),     # MV (move records)
+                # i32, not i8: packed i8 sublanes can't be dynamically
+                # row-indexed on Mosaic (offset must be a multiple of 4)
+                pltpu.VMEM((N + 1, LP), jnp.int32),    # MV (move records)
                 pltpu.VMEM((1, N), jnp.int32),         # base
                 pltpu.VMEM((1, N), jnp.float32),       # key
                 pltpu.VMEM((1, N), jnp.int32),         # cov
@@ -458,8 +503,15 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
         call = make(batch)
 
         def fn(bb_len, n_layers, lens, begins, ends, bb, bbw, seqs, ws):
-            return call(bb_len, n_layers, lens, begins, ends, bb, bbw, seqs,
-                        ws)
+            cb, cc, cl, fl, nn = call(
+                bb_len.reshape(batch, 1, 1), n_layers.reshape(batch, 1, 1),
+                lens.reshape(batch, 1, D), begins.reshape(batch, 1, D),
+                ends.reshape(batch, 1, D),
+                bb.reshape(batch, 1, BB), bbw.reshape(batch, 1, BB),
+                seqs, ws)
+            return (cb.reshape(batch, N), cc.reshape(batch, N),
+                    cl.reshape(batch, 1), fl.reshape(batch, 1),
+                    nn.reshape(batch, 1))
 
         return jax.jit(fn)
 

@@ -14,6 +14,21 @@ from __graft_entry__ import _force_cpu  # noqa: E402  (imports numpy only)
 
 _force_cpu(8)
 
+
+def _assert_cpu_mesh():
+    # Fail loudly if the forcing didn't take (e.g. a plugin initialized the
+    # backend first) — otherwise tests would hit the real TPU tunnel, which
+    # can wedge and hang the suite.
+    import jax
+
+    devs = jax.devices()
+    assert devs[0].platform == "cpu" and len(devs) >= 8, (
+        f"expected >=8 virtual CPU devices, got {len(devs)} "
+        f"{devs[0].platform} — backend initialized before conftest?")
+
+
+_assert_cpu_mesh()
+
 import gzip  # noqa: E402
 
 import pytest  # noqa: E402
