@@ -8,6 +8,10 @@
 #include <cstdlib>
 #include <numeric>
 
+#if defined(__AVX512BW__)
+#include <immintrin.h>
+#endif
+
 namespace rt {
 
 namespace {
@@ -249,54 +253,154 @@ std::string PoaGraph::generate_consensus(
 
 namespace {
 
+// Horizontal (gap-chain) pass of one DP row: row[j] = max over k<=j of
+// row[k] + (j-k)*gap. In t-space (t[j] = row[j] - j*gap, ramp precomputed
+// in jg) this is a prefix max. Generic version keeps the scalar chain.
+template <typename ScoreT>
+inline void horizontal_pass(ScoreT* __restrict row,
+                            const ScoreT* __restrict /*jg*/, uint32_t L,
+                            int8_t gap_) {
+  for (uint32_t j = 1; j <= L; ++j) {
+    const ScoreT left = static_cast<ScoreT>(row[j - 1] + gap_);
+    if (left > row[j]) {
+      row[j] = left;
+    }
+  }
+}
+
+#if defined(__AVX512BW__)
+// int16 fast path: 32-lane blocks, prefix max inside the register via five
+// shift-max steps (permutexvar word shifts), scalar carry across blocks.
+inline void horizontal_pass(int16_t* __restrict row,
+                            const int16_t* __restrict jg, uint32_t L,
+                            int8_t gap_) {
+  const uint32_t n = L + 1;
+  const __m512i vneg = _mm512_set1_epi16(INT16_MIN);
+  // shift-by-k index vectors: lane i reads lane i-k (masked to -inf below)
+  __m512i idx[5];
+  alignas(64) int16_t ibuf[32];
+  for (int s = 0, k = 1; s < 5; ++s, k *= 2) {
+    for (int i = 0; i < 32; ++i) {
+      ibuf[i] = static_cast<int16_t>(i >= k ? i - k : 0);
+    }
+    idx[s] = _mm512_load_si512(ibuf);
+  }
+  const __mmask32 keep[5] = {
+      static_cast<__mmask32>(~0x1u), static_cast<__mmask32>(~0x3u),
+      static_cast<__mmask32>(~0xFu), static_cast<__mmask32>(~0xFFu),
+      static_cast<__mmask32>(~0xFFFFu)};
+
+  int16_t carry = INT16_MIN;
+  uint32_t j = 0;
+  for (; j + 32 <= n; j += 32) {
+    __m512i t = _mm512_sub_epi16(_mm512_loadu_si512(row + j),
+                                 _mm512_loadu_si512(jg + j));
+    for (int s = 0; s < 5; ++s) {
+      const __m512i sh = _mm512_mask_permutexvar_epi16(vneg, keep[s],
+                                                       idx[s], t);
+      t = _mm512_max_epi16(t, sh);
+    }
+    t = _mm512_max_epi16(t, _mm512_set1_epi16(carry));
+    alignas(64) int16_t out[32];
+    _mm512_store_si512(out, t);
+    carry = out[31];
+    _mm512_storeu_si512(
+        row + j, _mm512_add_epi16(t, _mm512_loadu_si512(jg + j)));
+  }
+  // tail: scalar chain seeded with the carried prefix
+  int16_t run = carry;
+  for (; j < n; ++j) {
+    const int16_t t = static_cast<int16_t>(row[j] - jg[j]);
+    run = t > run ? t : run;
+    row[j] = static_cast<int16_t>(run + jg[j]);
+  }
+  (void)gap_;
+}
+#endif
+
 // DP + traceback core, templated on the score type (int16 when the score
 // range allows, halving memory traffic). Returns the REVERSED alignment.
+// preds come as CSR (poff/pdat) and scratch buffers are caller-owned so the
+// hot path makes no allocations in steady state; per-letter match-profile
+// rows turn the inner loop into pure ScoreT add/max streams (SPOA's SIMD
+// engines use the same profile trick).
 template <typename ScoreT>
 PoaAlignment dp_and_traceback(const PoaGraph& graph, const char* seq,
                               uint32_t L, const std::vector<int32_t>& sub,
-                              const std::vector<std::vector<int32_t>>& preds,
-                              std::vector<ScoreT>& h, int8_t match_,
+                              const int32_t* poff, const int32_t* pdat,
+                              std::vector<ScoreT>& h,
+                              std::vector<ScoreT>& prof,
+                              std::vector<int32_t>& prof_of,
+                              std::vector<uint8_t>& in_sub,
+                              std::vector<uint8_t>& has_out, int8_t match_,
                               int8_t mismatch_, int8_t gap_) {
   const uint32_t S = static_cast<uint32_t>(sub.size());
   const size_t stride = L + 1;
   // No full-matrix fill: every subgraph row is written before any read (key
   // order == topological order); only the virtual start row needs values.
-  h.resize(static_cast<size_t>(S + 1) * stride);
+  // One extra row at the tail holds the j*gap ramp for the horizontal pass.
+  h.resize(static_cast<size_t>(S + 2) * stride);
+  ScoreT* __restrict jg = h.data() + static_cast<size_t>(S + 1) * stride;
+  for (uint32_t j = 0; j <= L; ++j) {
+    jg[j] = static_cast<ScoreT>(static_cast<int32_t>(j) * gap_);
+  }
 
   for (uint32_t j = 0; j <= L; ++j) {
     h[j] = static_cast<ScoreT>(static_cast<int32_t>(j) * gap_);
   }
 
+  // Match-profile rows, one per distinct letter in the subgraph.
+  int32_t slot_of[256];
+  std::fill(std::begin(slot_of), std::end(slot_of), -1);
+  prof.clear();
+  prof_of.resize(S);
+  for (uint32_t r = 0; r < S; ++r) {
+    const unsigned char ub =
+        static_cast<unsigned char>(graph.nodes()[sub[r]].base);
+    int32_t s = slot_of[ub];
+    if (s < 0) {
+      s = static_cast<int32_t>(prof.size() / stride);
+      slot_of[ub] = s;
+      prof.resize(prof.size() + stride);
+      ScoreT* p = prof.data() + static_cast<size_t>(s) * stride;
+      p[0] = 0;
+      for (uint32_t j = 1; j <= L; ++j) {
+        p[j] = static_cast<ScoreT>(
+            seq[j - 1] == static_cast<char>(ub) ? match_ : mismatch_);
+      }
+    }
+    prof_of[r] = s;
+  }
+
   for (uint32_t r = 1; r <= S; ++r) {
-    const int32_t u = sub[r - 1];
-    const char ub = graph.nodes()[u].base;
     ScoreT* __restrict row = h.data() + static_cast<size_t>(r) * stride;
-    const auto& pr = preds[r - 1];
+    const ScoreT* __restrict pf =
+        prof.data() + static_cast<size_t>(prof_of[r - 1]) * stride;
+    const int32_t pb = poff[r - 1];
+    const int32_t pe = poff[r];
 
     // Diag/up pass over each predecessor row (vectorizable: row never
     // aliases a predecessor row — predecessors have strictly lower ranks),
     // then one sequential horizontal (gap-chain) pass.
     {
       const ScoreT* __restrict prow =
-          pr.empty() ? h.data()
-                     : h.data() + static_cast<size_t>(pr[0]) * stride;
+          pb == pe ? h.data()
+                   : h.data() + static_cast<size_t>(pdat[pb]) * stride;
       row[0] = static_cast<ScoreT>(prow[0] + gap_);
       for (uint32_t j = 1; j <= L; ++j) {
-        const ScoreT diag = static_cast<ScoreT>(
-            prow[j - 1] + (seq[j - 1] == ub ? match_ : mismatch_));
+        const ScoreT diag = static_cast<ScoreT>(prow[j - 1] + pf[j]);
         const ScoreT up = static_cast<ScoreT>(prow[j] + gap_);
         row[j] = diag > up ? diag : up;
       }
     }
-    for (size_t pi = 1; pi < pr.size(); ++pi) {
+    for (int32_t pi = pb + 1; pi < pe; ++pi) {
       const ScoreT* __restrict prow =
-          h.data() + static_cast<size_t>(pr[pi]) * stride;
+          h.data() + static_cast<size_t>(pdat[pi]) * stride;
       if (static_cast<ScoreT>(prow[0] + gap_) > row[0]) {
         row[0] = static_cast<ScoreT>(prow[0] + gap_);
       }
       for (uint32_t j = 1; j <= L; ++j) {
-        const ScoreT diag = static_cast<ScoreT>(
-            prow[j - 1] + (seq[j - 1] == ub ? match_ : mismatch_));
+        const ScoreT diag = static_cast<ScoreT>(prow[j - 1] + pf[j]);
         const ScoreT up = static_cast<ScoreT>(prow[j] + gap_);
         const ScoreT cand = diag > up ? diag : up;
         if (cand > row[j]) {
@@ -304,23 +408,21 @@ PoaAlignment dp_and_traceback(const PoaGraph& graph, const char* seq,
         }
       }
     }
-    // Horizontal pass (inherently sequential gap chain).
-    for (uint32_t j = 1; j <= L; ++j) {
-      const ScoreT left = static_cast<ScoreT>(row[j - 1] + gap_);
-      if (left > row[j]) {
-        row[j] = left;
-      }
-    }
+    // Horizontal pass. The gap chain row[j] = max(row[j], row[j-1]+g) is a
+    // loop-carried dependency (~70% of DP time when scalar); in t-space
+    // t[j] = row[j] - j*g it is a plain prefix max, computed per 32-lane
+    // block with in-register shift-max steps plus a scalar carry.
+    horizontal_pass(row, jg, L, gap_);
   }
 
   // End-node set: subgraph nodes without an out-edge inside the subgraph.
   // (An edge's dst is in the subgraph iff some preds entry references its
   // rank; recompute via a membership flag.)
-  std::vector<uint8_t> in_sub(graph.num_nodes(), 0);
+  in_sub.assign(graph.num_nodes(), 0);
   for (int32_t u : sub) {
     in_sub[u] = 1;
   }
-  std::vector<uint8_t> has_out(S, 0);
+  has_out.assign(S, 0);
   for (uint32_t r = 0; r < S; ++r) {
     for (int32_t e : graph.nodes()[sub[r]].out_edges) {
       if (in_sub[graph.edges()[e].dst]) {
@@ -355,12 +457,13 @@ PoaAlignment dp_and_traceback(const PoaGraph& graph, const char* seq,
     const int32_t u = sub[r - 1];
     const char ub = graph.nodes()[u].base;
     const ScoreT* row = h.data() + static_cast<size_t>(r) * stride;
-    const auto& pr = preds[r - 1];
+    const int32_t pb = poff[r - 1];
+    const int32_t pe = poff[r];
     const int32_t cur = row[j];
     bool moved = false;
 
     const int32_t sc = j > 0 ? (seq[j - 1] == ub ? match_ : mismatch_) : 0;
-    if (pr.empty()) {
+    if (pb == pe) {
       const ScoreT* prow = h.data();
       if (j > 0 && prow[j - 1] + sc == cur) {
         rev.emplace_back(u, static_cast<int32_t>(j) - 1);
@@ -373,7 +476,8 @@ PoaAlignment dp_and_traceback(const PoaGraph& graph, const char* seq,
         moved = true;
       }
     } else {
-      for (int32_t p : pr) {
+      for (int32_t pi = pb; pi < pe; ++pi) {
+        const int32_t p = pdat[pi];
         const ScoreT* prow = h.data() + static_cast<size_t>(p) * stride;
         if (j > 0 && prow[j - 1] + sc == cur) {
           rev.emplace_back(u, static_cast<int32_t>(j) - 1);
@@ -384,7 +488,8 @@ PoaAlignment dp_and_traceback(const PoaGraph& graph, const char* seq,
         }
       }
       if (!moved) {
-        for (int32_t p : pr) {
+        for (int32_t pi = pb; pi < pe; ++pi) {
+          const int32_t p = pdat[pi];
           const ScoreT* prow = h.data() + static_cast<size_t>(p) * stride;
           if (prow[j] + gap_ == cur) {
             rev.emplace_back(u, -1);
@@ -415,9 +520,13 @@ PoaAlignment PoaAligner::align(const char* seq, uint32_t len,
   }
 
   // Subgraph: nodes whose column key lies in [key_lo, key_hi], topo order.
+  // Keys are cached in a flat array so the sort comparator is two loads,
+  // not four indirections.
+  keys_.resize(graph.num_nodes());
   sub_.clear();
   for (uint32_t i = 0; i < graph.num_nodes(); ++i) {
     const double k = graph.col_key(graph.nodes()[i].col);
+    keys_[i] = k;
     if (k >= key_lo && k <= key_hi) {
       sub_.push_back(static_cast<int32_t>(i));
     }
@@ -426,10 +535,8 @@ PoaAlignment PoaAligner::align(const char* seq, uint32_t len,
     return result;
   }
   std::sort(sub_.begin(), sub_.end(), [&](int32_t a, int32_t b) {
-    const double ka = graph.col_key(graph.nodes()[a].col);
-    const double kb = graph.col_key(graph.nodes()[b].col);
-    if (ka != kb) {
-      return ka < kb;
+    if (keys_[a] != keys_[b]) {
+      return keys_[a] < keys_[b];
     }
     return a < b;
   });
@@ -441,13 +548,24 @@ PoaAlignment PoaAligner::align(const char* seq, uint32_t len,
   }
 
   // Predecessor ranks per subgraph node (edges from outside the key range
-  // are cut, turning their targets into subgraph sources).
-  std::vector<std::vector<int32_t>> preds(S);
+  // are cut, turning their targets into subgraph sources). CSR layout in
+  // reused scratch — the nested-vector version spent more time in
+  // allocator churn than in the DP at shallow depths.
+  preds_off_.assign(S + 1, 0);
   for (uint32_t r = 0; r < S; ++r) {
+    int32_t cnt = 0;
+    for (int32_t e : graph.nodes()[sub_[r]].in_edges) {
+      cnt += rank_of_[graph.edges()[e].src] > 0;
+    }
+    preds_off_[r + 1] = preds_off_[r] + cnt;
+  }
+  preds_dat_.resize(preds_off_[S]);
+  for (uint32_t r = 0; r < S; ++r) {
+    int32_t w = preds_off_[r];
     for (int32_t e : graph.nodes()[sub_[r]].in_edges) {
       const int32_t pr = rank_of_[graph.edges()[e].src];
       if (pr > 0) {
-        preds[r].push_back(pr);
+        preds_dat_[w++] = pr;
       }
     }
   }
@@ -459,13 +577,21 @@ PoaAlignment PoaAligner::align(const char* seq, uint32_t len,
   const int64_t max_param = std::max<int64_t>(
       {std::abs((int)match_), std::abs((int)mismatch_), std::abs((int)gap_)});
   const int64_t bound = static_cast<int64_t>(S + L + 2) * max_param;
+  // t-space values in the horizontal prefix max reach bound + L*|gap|;
+  // both must fit int16 for the fast path.
+  const int64_t t_bound =
+      bound + static_cast<int64_t>(L) * std::abs((int)gap_);
   PoaAlignment rev;
-  if (bound < 30000) {
-    rev = dp_and_traceback<int16_t>(graph, seq, L, sub_, preds, h16_, match_,
+  if (t_bound < 32000) {
+    rev = dp_and_traceback<int16_t>(graph, seq, L, sub_, preds_off_.data(),
+                                    preds_dat_.data(), h16_, prof16_,
+                                    prof_of_, in_sub_, has_out_, match_,
                                     mismatch_, gap_);
   } else {
-    rev = dp_and_traceback<int32_t>(graph, seq, L, sub_, preds, h_, match_,
-                                    mismatch_, gap_);
+    rev = dp_and_traceback<int32_t>(graph, seq, L, sub_, preds_off_.data(),
+                                    preds_dat_.data(), h_, prof32_, prof_of_,
+                                    in_sub_, has_out_, match_, mismatch_,
+                                    gap_);
   }
   result.assign(rev.rbegin(), rev.rend());
   return result;

@@ -115,6 +115,14 @@ class PoaAligner {
   std::vector<int16_t> h16_;     // narrow-range fast path
   std::vector<int32_t> sub_;     // subgraph node ids in topo order
   std::vector<int32_t> rank_of_; // node id -> rank (1-based), 0 = absent
+  // Steady-state scratch (no per-call allocation on the hot path):
+  std::vector<int32_t> preds_off_;  // CSR offsets into preds_dat_, size S+1
+  std::vector<int32_t> preds_dat_;  // predecessor ranks, flat
+  std::vector<int16_t> prof16_;     // per-letter match-profile rows
+  std::vector<int32_t> prof32_;
+  std::vector<int32_t> prof_of_;    // rank -> profile row index
+  std::vector<double> keys_;        // node id -> column key (sort cache)
+  std::vector<uint8_t> in_sub_, has_out_;
 };
 
 }  // namespace rt

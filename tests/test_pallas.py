@@ -173,6 +173,55 @@ def test_pallas_failure_degrades_to_xla_kernel(tmp_path, monkeypatch,
     assert res[0][1] == target
 
 
+def test_pallas_runtime_failure_at_drain_degrades(tmp_path, monkeypatch,
+                                                  capsys):
+    """JAX async dispatch surfaces Mosaic runtime failures at the blocking
+    transfer, not at the kernel call — the drain-time recovery must re-run
+    the retained packed chunk through the XLA kernel and mark the geometry
+    dead."""
+    import racon_tpu
+    from racon_tpu.ops import poa_driver
+
+    target = "ACGT" * 60
+    with open(tmp_path / "t.fasta", "w") as f:
+        f.write(f">t\n{target}\n")
+    with open(tmp_path / "r.fasta", "w") as f:
+        for i in range(4):
+            f.write(f">r{i}\n{target}\n")
+    with open(tmp_path / "o.sam", "w") as f:
+        f.write("@HD\tVN:1.6\n")
+        for i in range(4):
+            f.write(f"r{i}\t0\tt\t1\t60\t{len(target)}M\t*\t0\t0\t{target}"
+                    f"\t*\n")
+
+    class _LazyFail:
+        """Stands in for a device future whose error surfaces on transfer."""
+
+        def __array__(self, *a, **k):
+            raise RuntimeError("synthetic async mosaic failure")
+
+    def async_broken_kernel(cfg, interpret=False):
+        def make(batch):
+            def call(*args):
+                return tuple(_LazyFail() for _ in range(5))
+            return call
+        return make
+
+    monkeypatch.setenv("RACON_TPU_PALLAS", "1")
+    monkeypatch.setattr("racon_tpu.ops.poa_pallas.build_pallas_poa_kernel",
+                        async_broken_kernel)
+    p = racon_tpu.TpuPolisher(str(tmp_path / "r.fasta"),
+                              str(tmp_path / "o.sam"),
+                              str(tmp_path / "t.fasta"),
+                              window_length=100, match=5, mismatch=-4,
+                              gap=-8)
+    p.initialize()
+    res = p.polish(True)
+    assert len(res) == 1
+    assert res[0][1] == target
+    assert "falling back to the XLA kernel" in capsys.readouterr().err
+
+
 def test_pallas_matches_host_and_jax():
     cfg = poa.PoaConfig(max_nodes=384, max_len=256, max_backbone=128,
                         max_edges=12, depth=8, match=5, mismatch=-4, gap=-8)
