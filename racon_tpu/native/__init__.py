@@ -142,6 +142,10 @@ def load() -> ctypes.CDLL:
     lib.rt_pipeline_result_data.restype = ctypes.c_void_p
     lib.rt_pipeline_result_data.argtypes = [ctypes.c_void_p, ctypes.c_uint64, u64p]
 
+    lib.rt_pipeline_get_consensus.restype = ctypes.c_void_p
+    lib.rt_pipeline_get_consensus.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_uint64, u64p]
+
     lib.rt_pipeline_window_type.restype = ctypes.c_int
     lib.rt_pipeline_window_type.argtypes = [ctypes.c_void_p]
 

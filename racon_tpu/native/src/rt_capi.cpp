@@ -202,7 +202,7 @@ void rt_pipeline_window_info(void* handle, uint64_t i, uint64_t* out6) {
   });
 }
 
-// Export a window's backbone and layers, layers stably sorted by begin
+// Export a window's backbone and layers, layers sorted by begin
 // position (the order the consensus phase consumes them in).
 // weights are (PHRED - 33) when quality exists, 1 otherwise; backbone always
 // has a quality view (dummy '!' when the target had none).
@@ -223,7 +223,10 @@ void rt_pipeline_window_export(void* handle, uint64_t i, uint8_t* bb_bases,
   for (uint32_t k = 1; k < w.sequences.size(); ++k) {
     order.push_back(k);
   }
-  std::stable_sort(order.begin(), order.end(), [&](uint32_t a, uint32_t b) {
+  // Unstable sort, same comparator and element count as the host path's
+  // layer ordering (rt_window.cpp) — introsort is deterministic for a
+  // given input, so the device path sees layers in the identical order.
+  std::sort(order.begin(), order.end(), [&](uint32_t a, uint32_t b) {
     return w.positions[a].first < w.positions[b].first;
   });
 
@@ -296,6 +299,15 @@ const char* rt_pipeline_result_data(void* handle, uint64_t i, uint64_t* len) {
   auto* h = static_cast<PipelineHandle*>(handle);
   *len = h->results[i].second.size();
   return h->results[i].second.c_str();
+}
+
+// Per-window consensus as currently stored (set by consensus_cpu_one or
+// set_consensus); differential tests read the host result through this.
+const char* rt_pipeline_get_consensus(void* handle, uint64_t i,
+                                      uint64_t* len) {
+  const auto& w = static_cast<PipelineHandle*>(handle)->pipeline->window(i);
+  *len = w.consensus.size();
+  return w.consensus.c_str();
 }
 
 int rt_pipeline_window_type(void* handle) {

@@ -16,7 +16,7 @@ namespace rt {
 
 namespace {
 constexpr int32_t kNegInf = std::numeric_limits<int32_t>::min() / 4;
-}
+}  // namespace
 
 int32_t PoaGraph::new_column(double key) {
   col_keys_.push_back(key);

@@ -154,6 +154,12 @@ class Pipeline:
         self._lib.rt_pipeline_consensus_cpu_all(self._h)
         native.check_error(self._lib)
 
+    def get_consensus(self, i: int) -> bytes:
+        """Window i's stored consensus (host- or device-produced)."""
+        ln = ctypes.c_uint64()
+        p = self._lib.rt_pipeline_get_consensus(self._h, i, ctypes.byref(ln))
+        return ctypes.string_at(p, ln.value)
+
     def set_consensus(self, i: int, consensus: bytes, polished: bool) -> None:
         self._lib.rt_pipeline_set_consensus(
             self._h, i, consensus, len(consensus), 1 if polished else 0)

@@ -155,6 +155,44 @@ def test_wrapper_parallel_jobs_matches_sequential(tmp_path):
     assert seq_run.stdout.count(">") == 3
 
 
+def test_wrapper_jobs_tpu_path_matches_sequential(tmp_path):
+    """The multi-host (DCN) topology with the DEVICE path: two worker
+    processes polish disjoint chunks through the accelerator pipeline and
+    the ordered gather is byte-identical to one sequential host. Chunks
+    are independent, so the only cross-host traffic is this gather —
+    SURVEY.md §5.8."""
+    import random
+    rng = random.Random(7)
+    with open(tmp_path / "targets.fasta", "w") as tf, \
+            open(tmp_path / "reads.fasta", "w") as rf, \
+            open(tmp_path / "ovl.sam", "w") as of:
+        of.write("@HD\tVN:1.6\n")
+        for t in range(3):
+            seq = "".join(rng.choice("ACGT") for _ in range(200))
+            tf.write(f">t{t}\n{seq}\n")
+            for i in range(4):
+                rf.write(f">t{t}r{i}\n{seq}\n")
+                of.write(f"t{t}r{i}\t0\tt{t}\t1\t60\t200M\t*\t0\t0\t{seq}"
+                         f"\t*\n")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo)
+    base = [sys.executable, "-m", "racon_tpu.tools.wrapper",
+            "--split", "300", "--tpu", "-m", "5", "-x", "-4", "-g", "-8",
+            str(tmp_path / "reads.fasta"), str(tmp_path / "ovl.sam"),
+            str(tmp_path / "targets.fasta")]
+    seq_run = subprocess.run(base, capture_output=True, text=True,
+                             timeout=600, cwd=str(tmp_path), env=env)
+    assert seq_run.returncode == 0, seq_run.stderr
+    par_run = subprocess.run(base + ["--jobs", "2"], capture_output=True,
+                             text=True, timeout=600, cwd=str(tmp_path),
+                             env=env)
+    assert par_run.returncode == 0, par_run.stderr
+    assert "host worker for chunk" in par_run.stderr  # parallel path taken
+    assert par_run.stdout == seq_run.stdout
+    assert seq_run.stdout.count(">") == 3
+
+
 def test_wrapper_resume_checkpoints(tmp_path):
     """--resume persists per-chunk outputs and reuses them on rerun."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
