@@ -12,26 +12,53 @@ import os
 import numpy as np
 
 
-def _use_device() -> bool:
-    # Off by default: the host banded block-Myers aligner (bit-parallel,
-    # ~64 cells/op) measures faster than the lane-per-cell device kernel for
-    # this phase, on-chip included (58s vs ~1s on the lambda workload). The
-    # device aligner remains available for experimentation and as the base
-    # for a future wavefront kernel.
-    return os.environ.get("RACON_TPU_DEVICE_ALIGNER", "0") == "1"
+def _engine() -> str:
+    """Which aligner serves phase 1: 'host' (default), 'hirschberg'
+    (Pallas distance kernels + host-orchestrated splitting — covers
+    full-length reads in O(band) memory), or 'xla' (the moves-matrix
+    kernel, small pairs only).
+
+    Host stays the default until the Pallas engine has an on-hardware win
+    recorded (docs/benchmarks.md); the reference makes the same call the
+    other way because its GPU aligner is proven
+    (/root/reference/src/cuda/cudapolisher.cpp:74-214).
+    """
+    env = os.environ.get("RACON_TPU_DEVICE_ALIGNER", "0")
+    if env in ("0", ""):
+        return "host"
+    if env in ("1", "xla"):
+        return "xla"
+    if env == "hirschberg":
+        return "hirschberg"
+    import sys
+    print(f"[racon_tpu::align] WARNING: unknown RACON_TPU_DEVICE_ALIGNER="
+          f"{env!r}; using the host aligner (valid: 0, 1/xla, hirschberg)",
+          file=sys.stderr)
+    return "host"
 
 
 def run_alignment_phase(pipeline, progress: bool = False) -> dict:
     stats = {"device": 0, "host": 0}
     n = pipeline.num_align_jobs()
-    if n and _use_device():
-        from . import align
+    engine = _engine()
+    if n and engine != "host":
+        if engine == "hirschberg":
+            from . import align_pallas
 
-        lengths = pipeline.align_job_lengths()
-        jobs = [i for i in range(n)
-                if align.device_eligible(lengths[i, 0], lengths[i, 1])]
-        if jobs:
-            stats["device"] = align.run_jobs(pipeline, jobs)
+            lengths = pipeline.align_job_lengths()
+            jobs = [i for i in range(n)
+                    if align_pallas.band_for(int(lengths[i, 0]),
+                                             int(lengths[i, 1])) > 0]
+            if jobs:
+                stats["device"] = align_pallas.run_jobs(pipeline, jobs)
+        else:
+            from . import align
+
+            lengths = pipeline.align_job_lengths()
+            jobs = [i for i in range(n)
+                    if align.device_eligible(lengths[i, 0], lengths[i, 1])]
+            if jobs:
+                stats["device"] = align.run_jobs(pipeline, jobs)
     # Host finishes everything still CIGAR-less (device-rejected or
     # ineligible).
     pipeline.align_jobs_cpu()

@@ -1,0 +1,522 @@
+"""Pallas banded global aligner: Hirschberg splitting over distance-only
+kernels.
+
+TPU-native replacement for the edlib seam (reference:
+/root/reference/src/overlap.cpp:205-224) built for FULL-LENGTH reads. The
+moves-matrix design (ops/align.py) needs O(rows x band) memory per pair,
+which caps device-eligible pairs far below ONT read lengths; this engine
+keeps only O(band) state per kernel program — the classic
+divide-and-conquer (Hirschberg) trick:
+
+  * forward kernel: banded unit-cost DP over a row range, returning ONLY
+    the final score row (O(band) VMEM);
+  * backward kernel: the mirrored recurrence from the bottom edge;
+  * the host picks the optimal crossing column at the midpoint row from
+    F + B and splits the problem in two — numpy bookkeeping, batched
+    kernel launches, ~log2(n/base) rounds;
+  * base-case kernel: subproblems of <= BASE_ROWS rows run the full
+    moves-matrix DP in VMEM with in-kernel traceback, emitting op codes.
+
+Mosaic constraints honored throughout (no scalar VMEM stores — masked row
+RMW; no dynamic-lane scalar loads — masked reductions; 3-D per-program
+blocks; i32 everywhere).
+
+Costs are unit (edit distance), matching the reference's edlib NW config.
+In-band-only contract as the reference's banded CUDA aligner; pairs whose
+optimal path escapes the band are detected (INF at a midpoint) and left to
+the host engine.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .encoding import encode
+
+INF = 1 << 28
+BASE_ROWS = 256          # subproblems at or below this row count run the
+                         # full traceback kernel
+ROW_BUCKETS = (512, 1024, 2048, 4096, 8192, 16384, 32768, 49152)
+BANDS = (256, 512, 1024, 2048)
+
+
+def band_for(n: int, m: int, band_hint: int = 0) -> int:
+    """Band bucket: 10% of the larger side (reference auto-band rule,
+    src/cuda/cudapolisher.cpp:159-163) plus the diagonal drift."""
+    need = max(band_hint, abs(m - n) + max(n, m) // 10 + 2)
+    for b in BANDS:
+        if need <= b:
+            return b
+    return 0  # host
+
+
+def _round_up(x, m):
+    return (x + m - 1) // m * m
+
+
+# ---------------------------------------------------------------------------
+# distance-only kernels
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def _build_edge_kernel(rcap: int, K: int, backward: bool,
+                       interpret: bool = False):
+    """Batched banded DP over up to `rcap` rows; returns the last row.
+
+    Per task (one grid program): query slice q (rcap), target slice t
+    (rcap + K), scalars R (rows), S (target span), dmin (local band
+    offset). Lane o of a row holds cell (i, j = i + dmin + o); the
+    backward kernel mirrors the recurrence (B[i][o] from B[i+1][o],
+    B[i+1][o-1]... expressed with opposite shifts).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    TCAP = rcap + K
+
+    def kernel(scal_ref, q_ref, t_ref, out_ref, row_scr, tq_scr):
+        lane_k = jax.lax.broadcasted_iota(jnp.int32, (1, K), 1)
+        R = scal_ref[0, 0, 0]
+        S = scal_ref[0, 0, 1]
+        dmin = scal_ref[0, 0, 2]
+
+        QW = q_ref.shape[-1]
+
+        def lroll(x, amt, width):
+            # left-rotate by a (possibly negative) traced amount;
+            # pltpu.roll only accepts non-negative shifts
+            return pltpu.roll(x, jnp.mod(width - amt, width), 1)
+
+        def qchar(i):
+            # q char at index i: rotate the lane row and read lane 0
+            # (static extracts are allowed; dynamic-lane loads are not)
+            return lroll(q_ref[0], i, QW)[0, 0]
+
+        def cummin_fwd(x):
+            # prefix min along lanes (left-to-right)
+            k = 1
+            while k < K:
+                sh = jnp.where(lane_k >= k, pltpu.roll(x, k, 1), INF)
+                x = jnp.minimum(x, sh)
+                k *= 2
+            return x
+
+        def cummin_bwd(x):
+            # suffix min along lanes (right-to-left)
+            k = 1
+            while k < K:
+                sh = jnp.where(lane_k < K - k, pltpu.roll(x, K - k, 1),
+                               INF)
+                x = jnp.minimum(x, sh)
+                k *= 2
+            return x
+
+        if not backward:
+            # row 0: F[0][j'] = j' for j' in [0, S]
+            j0 = dmin + lane_k
+            row = jnp.where((j0 >= 0) & (j0 <= S), j0, INF)
+
+            def body(i, row):
+                # i = 1..R ; j' = i + dmin + o
+                jv = i + dmin + lane_k
+                qc = qchar(i - 1)
+                # target chars at j'-1 per lane: t[(i-1) + dmin + o],
+                # staged via a dynamic lane rotation of the target row
+                tc = lroll(tq_scr[:], i - 1 + dmin, TCAP)[:, :K]
+                sub = row + jnp.where(tc == qc, 0, 1)
+                up = jnp.where(lane_k < K - 1, pltpu.roll(row, K - 1, 1),
+                               INF) + 1
+                V = jnp.minimum(sub, up)
+                V = jnp.where(jv == 0, i, V)
+                V = jnp.where((jv < 0) | (jv > S), INF, V)
+                gv = lane_k
+                nrow = cummin_fwd(V - gv) + gv
+                nrow = jnp.minimum(nrow, INF)
+                nrow = jnp.where((jv < 0) | (jv > S), INF, nrow)
+                return nrow
+
+            tq_scr[:] = t_ref[0]
+            row = jax.lax.fori_loop(1, R + 1, body, row)
+        else:
+            # row R: B[R][j'] = S - j'
+            jR = R + dmin + lane_k
+            row = jnp.where((jR >= 0) & (jR <= S), S - jR, INF)
+
+            def body(k, row):
+                i = R - 1 - k          # i = R-1 .. 0
+                jv = i + dmin + lane_k
+                qc = qchar(i)
+                tc = lroll(tq_scr[:], i + dmin, TCAP)[:, :K]  # t[j']
+                # B[i][o]: diag = B[i+1][o] + sub(q[i], t[j']);
+                # down (consume query) = B[i+1][o-1] + 1;
+                # right (consume target) = B[i][o+1] + 1 (suffix chain)
+                sub = row + jnp.where(tc == qc, 0, 1)
+                down = jnp.where(lane_k >= 1, pltpu.roll(row, 1, 1),
+                                 INF) + 1
+                V = jnp.minimum(sub, down)
+                V = jnp.where(jv == S, R - i, V)
+                V = jnp.where((jv < 0) | (jv > S), INF, V)
+                gv = K - 1 - lane_k
+                nrow = cummin_bwd(V - gv) + gv
+                nrow = jnp.minimum(nrow, INF)
+                nrow = jnp.where((jv < 0) | (jv > S), INF, nrow)
+                return nrow
+
+            tq_scr[:] = t_ref[0]
+            row = jax.lax.fori_loop(0, R, body, row)
+
+        out_ref[0] = row
+
+    def make(batch):
+        smem3 = pl.BlockSpec((1, 1, 4), lambda b: (b, 0, 0),
+                             memory_space=pltpu.SMEM)
+        vrow = lambda w: pl.BlockSpec((1, 1, w), lambda b: (b, 0, 0),
+                                      memory_space=pltpu.VMEM)
+        return pl.pallas_call(
+            kernel,
+            grid=(batch,),
+            in_specs=[smem3, vrow(rcap), vrow(TCAP)],
+            out_specs=vrow(K),
+            out_shape=jax.ShapeDtypeStruct((batch, 1, K), jnp.int32),
+            scratch_shapes=[pltpu.VMEM((1, K), jnp.int32),
+                            pltpu.VMEM((1, TCAP), jnp.int32)],
+            interpret=interpret,
+        )
+
+    @functools.lru_cache(maxsize=8)
+    def jitted(batch):
+        call = make(batch)
+
+        def fn(scal, q, t):
+            out = call(scal.reshape(batch, 1, 4),
+                       q.reshape(batch, 1, rcap),
+                       t.reshape(batch, 1, TCAP))
+            return out.reshape(batch, K)
+
+        return jax.jit(fn)
+
+    return jitted
+
+
+# ---------------------------------------------------------------------------
+# base-case kernel: full moves + in-kernel traceback
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=32)
+def _build_base_kernel(K: int, interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    RB = BASE_ROWS
+    TCAP = RB + K
+    OPS = _round_up(RB + K + 2, 128)
+
+    def kernel(scal_ref, q_ref, t_ref, ops_ref, cnt_ref, ok_ref,
+               MVS, tq_scr):
+        lane_k = jax.lax.broadcasted_iota(jnp.int32, (1, K), 1)
+        lane_ops = jax.lax.broadcasted_iota(jnp.int32, (1, OPS), 1)
+        R = scal_ref[0, 0, 0]
+        S = scal_ref[0, 0, 1]
+        dmin = scal_ref[0, 0, 2]
+
+        def load_lane(rowvec, iota, idx):
+            return jnp.sum(jnp.where(iota == idx, rowvec,
+                                     jnp.zeros_like(rowvec)))
+
+        def cummin_fwd(x):
+            k = 1
+            while k < K:
+                sh = jnp.where(lane_k >= k, pltpu.roll(x, k, 1), INF)
+                x = jnp.minimum(x, sh)
+                k *= 2
+            return x
+
+        tq_scr[:] = t_ref[0]
+        j0 = dmin + lane_k
+        row0 = jnp.where((j0 >= 0) & (j0 <= S), j0, INF)
+
+        def body(i, row):
+            jv = i + dmin + lane_k
+            QW = q_ref.shape[-1]
+            qc = pltpu.roll(q_ref[0], jnp.mod(QW - (i - 1), QW), 1)[0, 0]
+            tc = pltpu.roll(tq_scr[:], jnp.mod(TCAP - (i - 1 + dmin), TCAP),
+                            1)[:, :K]
+            sub = row + jnp.where(tc == qc, 0, 1)
+            up = jnp.where(lane_k < K - 1, pltpu.roll(row, K - 1, 1),
+                           INF) + 1
+            V = jnp.minimum(sub, up)
+            mv = jnp.where(V == sub, 0, 1)
+            V = jnp.where(jv == 0, i, V)
+            mv = jnp.where(jv == 0, 1, mv)
+            V = jnp.where((jv < 0) | (jv > S), INF, V)
+            nrow = cummin_fwd(V - lane_k) + lane_k
+            mv = jnp.where(nrow < V, 2, mv)
+            nrow = jnp.where((jv < 0) | (jv > S), INF, nrow)
+            MVS[pl.ds(i - 1, 1), :] = mv
+            return nrow
+
+        jax.lax.fori_loop(1, R + 1, body, row0)
+
+        # traceback from (R, S) to (0, 0); ops: 0=M 1=I(query) 2=D(target)
+        def cond(c):
+            i, j, cnt, ok = c
+            return ((i > 0) | (j > 0)) & (cnt < OPS) & ok
+
+        def bodytb(c):
+            i, j, cnt, ok = c
+            o = j - i - dmin
+            in_band = (o >= 0) & (o < K)
+            mvrow = MVS[pl.ds(jnp.maximum(i - 1, 0), 1), :]
+            mv_at = load_lane(mvrow, lane_k, jnp.clip(o, 0, K - 1))
+            mv = jnp.where(i > 0, jnp.where(in_band, mv_at, 3), 2)
+            ok = ok & (mv != 3)
+            ops_ref[0] = jnp.where(lane_ops == cnt, mv, ops_ref[0])
+            i = jnp.where(mv == 2, i, i - 1)
+            j = jnp.where(mv == 1, j, j - 1)
+            return (i, j, cnt + 1, ok)
+
+        ops_ref[0] = jnp.zeros((1, OPS), jnp.int32)
+        i, j, cnt, ok = jax.lax.while_loop(
+            cond, bodytb, (R, S, jnp.int32(0), jnp.bool_(True)))
+        ok = ok & (i == 0) & (j == 0)
+        cnt_ref[0, 0, 0] = cnt
+        ok_ref[0, 0, 0] = ok.astype(jnp.int32)
+
+    def make(batch):
+        smem3 = pl.BlockSpec((1, 1, 4), lambda b: (b, 0, 0),
+                             memory_space=pltpu.SMEM)
+        smem1 = pl.BlockSpec((1, 1, 1), lambda b: (b, 0, 0),
+                             memory_space=pltpu.SMEM)
+        vrow = lambda w: pl.BlockSpec((1, 1, w), lambda b: (b, 0, 0),
+                                      memory_space=pltpu.VMEM)
+        return pl.pallas_call(
+            kernel,
+            grid=(batch,),
+            in_specs=[smem3, vrow(_round_up(RB, 128)), vrow(TCAP)],
+            out_specs=[vrow(OPS), smem1, smem1],
+            out_shape=[
+                jax.ShapeDtypeStruct((batch, 1, OPS), jnp.int32),
+                jax.ShapeDtypeStruct((batch, 1, 1), jnp.int32),
+                jax.ShapeDtypeStruct((batch, 1, 1), jnp.int32),
+            ],
+            scratch_shapes=[pltpu.VMEM((RB, K), jnp.int32),
+                            pltpu.VMEM((1, TCAP), jnp.int32)],
+            interpret=interpret,
+        )
+
+    @functools.lru_cache(maxsize=8)
+    def jitted(batch):
+        call = make(batch)
+        QCAP = _round_up(RB, 128)
+
+        def fn(scal, q, t):
+            ops, cnt, ok = call(scal.reshape(batch, 1, 4),
+                                q.reshape(batch, 1, QCAP),
+                                t.reshape(batch, 1, TCAP))
+            return (ops.reshape(batch, OPS), cnt.reshape(batch),
+                    ok.reshape(batch))
+
+        return jax.jit(fn)
+
+    return jitted, OPS, _round_up(RB, 128), TCAP
+
+
+# ---------------------------------------------------------------------------
+# host orchestrator
+# ---------------------------------------------------------------------------
+
+class _Task:
+    __slots__ = ("pair", "ia", "ib", "ja", "jb")
+
+    def __init__(self, pair, ia, ib, ja, jb):
+        self.pair, self.ia, self.ib, self.ja, self.jb = pair, ia, ib, ja, jb
+
+
+def _interpret() -> bool:
+    import jax as _jax
+    return _jax.devices()[0].platform != "tpu"
+
+
+def align_pairs(pairs, *, interpret=None):
+    """pairs: [(q_codes int32 np, t_codes int32 np)] -> [ops np | None].
+
+    ops are forward-ordered codes (0=M, 1=I, 2=D); None = host fallback
+    (band escape / oversize).
+    """
+    if interpret is None:
+        interpret = _interpret()
+    results = [None] * len(pairs)
+    segments = {}   # pair index -> list of (ia, ops array)
+    bands = {}
+    active = []
+    for idx, (q, t) in enumerate(pairs):
+        n, m = len(q), len(t)
+        K = band_for(n, m)
+        if K == 0 or n == 0 or m == 0 or (n + 1) // 2 > ROW_BUCKETS[-1]:
+            continue
+        bands[idx] = (K, np.minimum(0, m - n) - (K - 1 - abs(m - n)) // 2)
+        segments[idx] = []
+        active.append(_Task(idx, 0, n, 0, m))
+
+    failed = set()
+    while True:
+        big = [t for t in active if (t.ib - t.ia) > BASE_ROWS
+               and t.pair not in failed]
+        if not big:
+            break
+        active = [t for t in active if (t.ib - t.ia) <= BASE_ROWS]
+        new_tasks = _split_round(pairs, big, bands, failed, interpret)
+        active.extend(new_tasks)
+
+    # base cases
+    base = [t for t in active if t.pair not in failed]
+    _solve_base(pairs, base, bands, segments, failed, interpret)
+
+    for idx, segs in segments.items():
+        if idx in failed:
+            continue
+        segs.sort(key=lambda s: s[0])
+        results[idx] = np.concatenate([s[1] for s in segs]) if segs else \
+            np.zeros(0, np.int32)
+    return results
+
+
+def _task_arrays(pairs, tasks, bands, rcap, K, backward):
+    """Pack tasks into kernel arrays. The staged target window is clipped
+    to the half's band-reachable columns (j <= ib + gdmin + K going
+    forward, j >= ia + gdmin going backward) so it fits rcap + K — the
+    full task span can be up to 2*rcap + K."""
+    B = len(tasks)
+    TCAP = rcap + K
+    scal = np.zeros((B, 4), np.int32)
+    qs = np.zeros((B, rcap), np.int32)
+    ts = np.full((B, TCAP), 255, np.int32)
+    for bi, t in enumerate(tasks):
+        q, tt = pairs[t.pair]
+        _, gdmin = bands[t.pair]
+        R = t.ib - t.ia
+        if backward:
+            j_lo = max(t.ja, t.ia + gdmin)
+            j_hi = t.jb
+        else:
+            j_lo = t.ja
+            j_hi = min(t.jb, t.ib + gdmin + K)
+        S = j_hi - j_lo
+        assert 0 <= S <= TCAP, (S, TCAP)
+        scal[bi] = (R, S, gdmin + t.ia - j_lo, 0)
+        qs[bi, :R] = q[t.ia:t.ib]
+        ts[bi, :S] = tt[j_lo:j_hi]
+    return scal, qs, ts
+
+
+def _split_round(pairs, tasks, bands, failed, interpret):
+    """One Hirschberg round: split every oversized task at its midpoint."""
+    out = []
+    by_bucket = {}
+    for t in tasks:
+        K = bands[t.pair][0]
+        R = t.ib - t.ia
+        half = (R + 1) // 2
+        rcap = next(rb for rb in ROW_BUCKETS if half <= rb)
+        by_bucket.setdefault((rcap, K), []).append(t)
+
+    for (rcap, K), group in sorted(by_bucket.items()):
+        fwd = _build_edge_kernel(rcap, K, False, interpret)
+        bwd = _build_edge_kernel(rcap, K, True, interpret)
+        # forward over [ia, imid], backward over [imid, ib]
+        f_tasks, b_tasks = [], []
+        for t in group:
+            imid = (t.ia + t.ib) // 2
+            f_tasks.append(_Task(t.pair, t.ia, imid, t.ja, t.jb))
+            b_tasks.append(_Task(t.pair, imid, t.ib, t.ja, t.jb))
+        fs, fq, ft = _task_arrays(pairs, f_tasks, bands, rcap, K, False)
+        bs, bq, bt = _task_arrays(pairs, b_tasks, bands, rcap, K, True)
+        F = np.asarray(fwd(len(group))(fs, fq, ft))
+        Bv = np.asarray(bwd(len(group))(bs, bq, bt))
+        for gi, t in enumerate(group):
+            imid = (t.ia + t.ib) // 2
+            K_, gdmin = bands[t.pair]
+            # Both midpoint rows map lane o to absolute column
+            # j = imid + gdmin + o (independent of each frame's clipped
+            # origin); overlay onto the task's column range rel. ja.
+            jmid = imid + gdmin - t.ja + np.arange(K_)
+            span = t.jb - t.ja
+            fv = np.full(span + 1, INF, np.int64)
+            bv = np.full(span + 1, INF, np.int64)
+            m = (jmid >= 0) & (jmid <= span)
+            fv[jmid[m]] = F[gi][m]
+            bv[jmid[m]] = Bv[gi][m]
+            tot = fv + bv
+            jstar = int(np.argmin(tot))
+            if tot[jstar] >= INF:
+                failed.add(t.pair)
+                continue
+            jabs = t.ja + jstar
+            out.append(_Task(t.pair, t.ia, imid, t.ja, jabs))
+            out.append(_Task(t.pair, imid, t.ib, jabs, t.jb))
+    return out
+
+
+def _solve_base(pairs, tasks, bands, segments, failed, interpret):
+    by_bucket = {}
+    for t in tasks:
+        K = bands[t.pair][0]
+        by_bucket.setdefault(K, []).append(t)
+    for K, group in sorted(by_bucket.items()):
+        kern, OPS, QCAP, TCAP = _build_base_kernel(K, interpret)
+        for off in range(0, len(group), 64):
+            chunk = group[off:off + 64]
+            B = len(chunk)
+            scal = np.zeros((B, 4), np.int32)
+            qs = np.zeros((B, QCAP), np.int32)
+            ts = np.full((B, TCAP), 255, np.int32)
+            for bi, t in enumerate(chunk):
+                q, tt = pairs[t.pair]
+                _, gdmin = bands[t.pair]
+                R, S = t.ib - t.ia, t.jb - t.ja
+                scal[bi] = (R, S, gdmin + t.ia - t.ja, 0)
+                qs[bi, :R] = q[t.ia:t.ib]
+                ts[bi, :S] = tt[t.ja:t.jb]
+            ops, cnt, ok = (np.asarray(x)
+                            for x in kern(B)(scal, qs, ts))
+            for bi, t in enumerate(chunk):
+                if not ok[bi]:
+                    failed.add(t.pair)
+                    continue
+                seg = ops[bi, :cnt[bi]][::-1].astype(np.int32)
+                segments[t.pair].append((t.ia, seg))
+
+
+_OPC = "MID"
+
+
+def ops_to_cigar(ops: np.ndarray) -> str:
+    if len(ops) == 0:
+        return ""
+    change = np.nonzero(np.diff(ops))[0]
+    starts = np.concatenate([[0], change + 1])
+    ends = np.concatenate([change + 1, [len(ops)]])
+    return "".join(f"{e - s}{_OPC[ops[s]]}" for s, e in zip(starts, ends))
+
+
+def run_jobs(pipeline, jobs, batch_unused: int = 0) -> int:
+    """Align pipeline jobs with the Hirschberg engine; install CIGARs.
+    Returns how many the device served (band escapes fall to host)."""
+    pairs = []
+    for job in jobs:
+        qa, ta = pipeline.align_job(job)
+        pairs.append((encode(qa).astype(np.int32),
+                      encode(ta).astype(np.int32)))
+    results = align_pairs(pairs)
+    served = 0
+    for job, ops in zip(jobs, results):
+        if ops is None:
+            continue
+        pipeline.set_job_cigar(job, ops_to_cigar(ops))
+        served += 1
+    return served

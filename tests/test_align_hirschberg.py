@@ -1,0 +1,159 @@
+"""Hirschberg Pallas aligner (ops/align_pallas.py) in interpret mode:
+the emitted op path must be a valid alignment whose cost equals the true
+(unbanded) edit distance whenever the optimal path stays in band.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from racon_tpu import native
+from racon_tpu.ops import align_pallas
+from racon_tpu.ops.encoding import encode
+from tests.test_align import mutate
+
+
+def path_cost(ops: np.ndarray, q: bytes, t: bytes) -> int:
+    """Edit cost of the forward-ordered op path (0=M, 1=I, 2=D)."""
+    cost = 0
+    qi = ti = 0
+    for op in ops:
+        if op == 0:
+            cost += q[qi] != t[ti]
+            qi += 1
+            ti += 1
+        elif op == 1:
+            cost += 1
+            qi += 1
+        else:
+            cost += 1
+            ti += 1
+    assert qi == len(q) and ti == len(t), (qi, len(q), ti, len(t))
+    return cost
+
+
+def _align_one(q: bytes, t: bytes):
+    res = align_pallas.align_pairs(
+        [(encode(np.frombuffer(q, np.uint8)).astype(np.int32),
+          encode(np.frombuffer(t, np.uint8)).astype(np.int32))],
+        interpret=True)
+    return res[0]
+
+
+def _rand(rng, n):
+    return bytes(rng.choice(b"ACGT") for _ in range(n))
+
+
+def test_base_case_exact():
+    rng = random.Random(1)
+    q = _rand(rng, 200)
+    t = mutate(q, 0.10, rng)
+    ops = _align_one(q, t)
+    assert ops is not None
+    assert path_cost(ops, q, t) == native.edit_distance(q, t)
+
+
+def test_multi_round_split_exact():
+    rng = random.Random(2)
+    q = _rand(rng, 1400)
+    t = mutate(q, 0.08, rng)
+    ops = _align_one(q, t)
+    assert ops is not None
+    assert path_cost(ops, q, t) == native.edit_distance(q, t)
+
+
+def test_identical_pair_all_match():
+    rng = random.Random(3)
+    q = _rand(rng, 700)
+    ops = _align_one(q, q)
+    assert ops is not None
+    assert (ops == 0).all()
+    assert len(ops) == len(q)
+
+
+def test_length_skew_within_band():
+    rng = random.Random(4)
+    q = _rand(rng, 900)
+    t = q[:400] + q[520:]  # 120-base deletion
+    ops = _align_one(q, t)
+    assert ops is not None
+    assert path_cost(ops, q, t) == native.edit_distance(q, t)
+
+
+def test_oversize_band_goes_to_host():
+    q = b"A" * 100
+    t = b"A" * 3000  # drift beyond the largest band bucket
+    assert _align_one(q, t) is None
+
+
+def test_polish_with_hirschberg_engine(tmp_path, monkeypatch):
+    """RACON_TPU_DEVICE_ALIGNER=hirschberg serves the PAF alignment phase
+    through the Pallas engine end-to-end; consensus matches the
+    host-aligned run within tie-break noise."""
+    import racon_tpu
+
+    rng = random.Random(11)
+    truth = "".join(rng.choice("ACGT") for _ in range(400))
+
+    def mut(s, rate):
+        out = []
+        for c in s:
+            r = rng.random()
+            if r < rate / 2:
+                out.append(rng.choice("ACGT"))
+            elif r < rate:
+                continue
+            else:
+                out.append(c)
+        return "".join(out)
+
+    draft = mut(truth, 0.02)
+    reads = [mut(truth, 0.05) for _ in range(5)]
+    with open(tmp_path / "t.fasta", "w") as f:
+        f.write(f">t\n{draft}\n")
+    with open(tmp_path / "r.fasta", "w") as rf, \
+            open(tmp_path / "o.paf", "w") as of:
+        for i, r in enumerate(reads):
+            rf.write(f">r{i}\n{r}\n")
+            of.write(f"r{i}\t{len(r)}\t0\t{len(r)}\t+\tt\t{len(draft)}\t0\t"
+                     f"{len(draft)}\t{min(len(r), len(draft))}\t"
+                     f"{max(len(r), len(draft))}\t60\n")
+
+    def run(engine):
+        monkeypatch.setenv("RACON_TPU_DEVICE_ALIGNER", engine)
+        p = racon_tpu.TpuPolisher(str(tmp_path / "r.fasta"),
+                                  str(tmp_path / "o.paf"),
+                                  str(tmp_path / "t.fasta"),
+                                  window_length=100, match=5, mismatch=-4,
+                                  gap=-8)
+        p.initialize()
+        return p.polish(True)
+
+    dev = run("hirschberg")
+    host = run("0")
+    assert len(dev) == len(host) == 1
+    d = native.edit_distance(dev[0][1].encode(), host[0][1].encode())
+    assert d <= 2, d
+    assert native.edit_distance(dev[0][1].encode(), truth.encode()) <= 8
+
+
+def test_cigar_roundtrip():
+    rng = random.Random(5)
+    q = _rand(rng, 300)
+    t = mutate(q, 0.1, rng)
+    ops = _align_one(q, t)
+    cigar = align_pallas.ops_to_cigar(ops)
+    qc = tc = 0
+    num = ""
+    for ch in cigar:
+        if ch.isdigit():
+            num += ch
+        else:
+            n = int(num)
+            num = ""
+            if ch in "MI":
+                qc += n
+            if ch in "MD":
+                tc += n
+    assert qc == len(q) and tc == len(t)

@@ -150,7 +150,7 @@ def test_wrapper_parallel_jobs_matches_sequential(tmp_path):
                              text=True, timeout=600, cwd=str(tmp_path),
                              env=env)
     assert par_run.returncode == 0, par_run.stderr
-    assert "host worker for chunk" in par_run.stderr
+    assert par_run.stderr.count("host worker for chunk") >= 2
     assert par_run.stdout == seq_run.stdout
     assert seq_run.stdout.count(">") == 3
 
