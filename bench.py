@@ -65,6 +65,39 @@ def device_healthy(timeout_s: int = 120) -> bool:
         return False
 
 
+def pallas_compiles(timeout_s: int = 900) -> bool:
+    """Bounded probe: compile + run the fused POA kernel at the production
+    w=500 geometry in a subprocess. A pathological Mosaic compile would
+    otherwise hang the whole bench (and can wedge the tunnel if killed
+    mid-flight — hence one bounded probe, whose result also warms the
+    persistent compilation cache for the real run)."""
+    probe = (
+        "import numpy as np, jax, sys\n"
+        "sys.path.insert(0, %r)\n"
+        "from racon_tpu.ops import poa, poa_driver, poa_pallas\n"
+        "import __graft_entry__ as g\n"
+        "cfg = poa_driver.make_config(500, 8, 5, -4, -8)\n"
+        "fn = poa_pallas.build_pallas_poa_kernel(cfg, interpret=False)(2)\n"
+        "bb, bbw, bl, nl, seqs, ws, lens, bg, en = "
+        "g._example_batch(cfg, 2, np.random.default_rng(0))\n"
+        "out = fn(bl.reshape(-1,1), nl.reshape(-1,1), lens, bg, en, "
+        "bb.astype(np.int32), bbw, seqs.astype(np.int32), ws)\n"
+        "jax.block_until_ready(out)\n"
+        "print('pallas-ok', np.asarray(out[2]).ravel().tolist())\n"
+    ) % os.path.dirname(os.path.abspath(__file__))
+    try:
+        r = subprocess.run([sys.executable, "-c", probe],
+                           capture_output=True, timeout=timeout_s, text=True)
+        if r.returncode != 0:
+            print("[bench] pallas probe failed:", r.stderr[-500:],
+                  file=sys.stderr)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        print(f"[bench] pallas probe exceeded {timeout_s}s; benching the "
+              "XLA device kernel instead", file=sys.stderr)
+        return False
+
+
 def run(backend: str, paths):
     import racon_tpu
 
@@ -101,6 +134,12 @@ def main():
         print(f"[bench] cpu: {bp_cpu} bp in {dt_cpu:.1f}s", file=sys.stderr)
         return
 
+    pallas_ok = pallas_compiles()
+    if not pallas_ok:
+        # Bound the blast radius: the XLA device kernel is the degraded
+        # tier; measure it honestly rather than hanging on Mosaic.
+        os.environ["RACON_TPU_PALLAS"] = "0"
+
     # Warm the device path once so compile time is not billed as throughput
     # (compiled kernels are cached for the steady-state measurement).
     run("tpu", paths)
@@ -110,9 +149,10 @@ def main():
 
     mbps_tpu = bp_tpu / dt_tpu / 1e6
     mbps_cpu = bp_cpu / dt_cpu / 1e6
+    kernel_tag = "" if pallas_ok else " [XLA kernel: pallas compile failed]"
     print(json.dumps({
         "metric": f"polished Mbp/sec (synthetic ONT {MBP} Mbp {COVERAGE}x, "
-                  "PAF, w=500, end-to-end)",
+                  f"PAF, w=500, end-to-end){kernel_tag}",
         "value": round(mbps_tpu, 4),
         "unit": "Mbp/s",
         "vs_baseline": round(mbps_tpu / mbps_cpu, 3),

@@ -386,6 +386,13 @@ def align_pairs(pairs, *, interpret=None):
     return results
 
 
+def _pow2(n):
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
 def _task_arrays(pairs, tasks, bands, rcap, K, backward):
     """Pack tasks into kernel arrays. The staged target window is clipped
     to the half's band-reachable columns (j <= ib + gdmin + K going
@@ -436,8 +443,14 @@ def _split_round(pairs, tasks, bands, failed, interpret):
             b_tasks.append(_Task(t.pair, imid, t.ib, t.ja, t.jb))
         fs, fq, ft = _task_arrays(pairs, f_tasks, bands, rcap, K, False)
         bs, bq, bt = _task_arrays(pairs, b_tasks, bands, rcap, K, True)
-        F = np.asarray(fwd(len(group))(fs, fq, ft))
-        Bv = np.asarray(bwd(len(group))(bs, bq, bt))
+        # pad the batch dim to a power of two so each (rcap, K) bucket
+        # compiles a handful of kernel variants, not one per group size
+        B = _pow2(len(group))
+        pad = lambda a: np.concatenate(
+            [a, np.repeat(a[-1:], B - len(group), axis=0)]) \
+            if B > len(group) else a
+        F = np.asarray(fwd(B)(pad(fs), pad(fq), pad(ft)))[:len(group)]
+        Bv = np.asarray(bwd(B)(pad(bs), pad(bq), pad(bt)))[:len(group)]
         for gi, t in enumerate(group):
             imid = (t.ia + t.ib) // 2
             K_, gdmin = bands[t.pair]
@@ -471,7 +484,7 @@ def _solve_base(pairs, tasks, bands, segments, failed, interpret):
         kern, OPS, QCAP, TCAP = _build_base_kernel(K, interpret)
         for off in range(0, len(group), 64):
             chunk = group[off:off + 64]
-            B = len(chunk)
+            B = _pow2(len(chunk))
             scal = np.zeros((B, 4), np.int32)
             qs = np.zeros((B, QCAP), np.int32)
             ts = np.full((B, TCAP), 255, np.int32)
@@ -482,6 +495,7 @@ def _solve_base(pairs, tasks, bands, segments, failed, interpret):
                 scal[bi] = (R, S, gdmin + t.ia - t.ja, 0)
                 qs[bi, :R] = q[t.ia:t.ib]
                 ts[bi, :S] = tt[t.ja:t.jb]
+            scal[len(chunk):, 0] = 1  # pad tasks: 1 empty-target row
             ops, cnt, ok = (np.asarray(x)
                             for x in kern(B)(scal, qs, ts))
             for bi, t in enumerate(chunk):
@@ -492,31 +506,26 @@ def _solve_base(pairs, tasks, bands, segments, failed, interpret):
                 segments[t.pair].append((t.ia, seg))
 
 
-_OPC = "MID"
+from .align import ops_to_cigar  # same 0=M/1=I/2=D convention
 
 
-def ops_to_cigar(ops: np.ndarray) -> str:
-    if len(ops) == 0:
-        return ""
-    change = np.nonzero(np.diff(ops))[0]
-    starts = np.concatenate([[0], change + 1])
-    ends = np.concatenate([change + 1, [len(ops)]])
-    return "".join(f"{e - s}{_OPC[ops[s]]}" for s, e in zip(starts, ends))
-
-
-def run_jobs(pipeline, jobs, batch_unused: int = 0) -> int:
+def run_jobs(pipeline, jobs, cohort: int = 64) -> int:
     """Align pipeline jobs with the Hirschberg engine; install CIGARs.
-    Returns how many the device served (band escapes fall to host)."""
-    pairs = []
-    for job in jobs:
-        qa, ta = pipeline.align_job(job)
-        pairs.append((encode(qa).astype(np.int32),
-                      encode(ta).astype(np.int32)))
-    results = align_pairs(pairs)
+    Returns how many the device served (band escapes fall to host).
+    Jobs are materialized per cohort so host memory stays O(cohort), not
+    O(total bases)."""
     served = 0
-    for job, ops in zip(jobs, results):
-        if ops is None:
-            continue
-        pipeline.set_job_cigar(job, ops_to_cigar(ops))
-        served += 1
+    for off in range(0, len(jobs), cohort):
+        group = jobs[off:off + cohort]
+        pairs = []
+        for job in group:
+            qa, ta = pipeline.align_job(job)
+            pairs.append((encode(qa).astype(np.int32),
+                          encode(ta).astype(np.int32)))
+        results = align_pairs(pairs)
+        for job, ops in zip(group, results):
+            if ops is None:
+                continue
+            pipeline.set_job_cigar(job, ops_to_cigar(ops))
+            served += 1
     return served
