@@ -369,6 +369,63 @@ std::string myers_banded_cigar(const char* q, uint32_t n, const char* t,
 }  // namespace
 
 // Myers/Hyyro bit-parallel global edit distance over 64-row blocks.
+namespace {
+
+// One banded block-Myers scoring pass with half-width k. Returns the
+// in-band distance D(n, m) — an overestimate of the true distance when
+// the optimal path leaves the band, exact when the result is <= k (the
+// Ukkonen criterion: every path of cost <= k stays within k diagonals of
+// the endpoint diagonals, and the band-top boundary only overestimates).
+int64_t banded_distance_pass(const std::vector<uint64_t>& peq, uint32_t n,
+                             const char* t, uint32_t m, int64_t k,
+                             std::vector<uint64_t>& vp,
+                             std::vector<uint64_t>& vn) {
+  const int64_t diff = static_cast<int64_t>(m) - static_cast<int64_t>(n);
+  const int64_t dmin = std::min<int64_t>(0, diff) - k;
+  const int64_t dmax = std::max<int64_t>(0, diff) + k;
+  const uint32_t W = (n + 63) / 64;
+  auto blo = [&](int64_t j) -> int64_t {
+    return (std::max<int64_t>(1, j - dmax) - 1) / 64;
+  };
+  auto bhi = [&](int64_t j) -> int64_t {
+    return (std::min<int64_t>(n, j - dmin) - 1) / 64;
+  };
+
+  vp.assign(W, ~0ull);
+  vn.assign(W, 0);
+  int64_t bot = 64ll * (bhi(1) + 1);  // score at the virtual band bottom
+  int64_t prev_bhi = bhi(1);
+  for (int64_t j = 1; j <= static_cast<int64_t>(m); ++j) {
+    const int64_t lo_b = blo(j), hi_b = bhi(j);
+    if (hi_b > prev_bhi) {  // fresh bottom blocks are all-VP (+1 per row)
+      bot += 64ll * (hi_b - prev_bhi);
+      prev_bhi = hi_b;
+    }
+    const uint8_t c = static_cast<uint8_t>(t[j - 1]);
+    int hin = 1;  // +1 per column at row 0 / band top (overestimate)
+    for (int64_t b = lo_b; b <= hi_b; ++b) {
+      hin = myers_block_step(peq[static_cast<size_t>(b) * 256 + c], vp[b],
+                             vn[b], hin);
+    }
+    bot += hin;
+  }
+  // Peel virtual rows below n off the final column.
+  int64_t score = bot;
+  for (int64_t r = 64ll * (bhi(m) + 1) - 1; r >= static_cast<int64_t>(n);
+       --r) {
+    const uint32_t b = static_cast<uint32_t>(r / 64);
+    const uint64_t bit = 1ull << (r % 64);
+    if (vp[b] & bit) {
+      --score;
+    } else if (vn[b] & bit) {
+      ++score;
+    }
+  }
+  return score;
+}
+
+}  // namespace
+
 int64_t edit_distance(const char* q, uint32_t q_len, const char* t,
                       uint32_t t_len) {
   if (q_len == 0) {
@@ -386,33 +443,25 @@ int64_t edit_distance(const char* q, uint32_t q_len, const char* t,
     peq[static_cast<size_t>(i / 64) * 256 + c] |= 1ull << (i % 64);
   }
 
-  std::vector<uint64_t> vp(W, ~0ull), vn(W, 0);
-  // Score at the bottom row of the last block (virtual rows beyond q_len
-  // never match, which keeps the recurrence exact for row q_len).
-  int64_t score = 64ll * W;
+  std::vector<uint64_t> vp(W), vn(W);
 
-
-  for (uint32_t j = 0; j < t_len; ++j) {
-    const uint8_t c = static_cast<uint8_t>(t[j]);
-    int hin = 1;  // top boundary D[0][j] = j increments every column
-    for (uint32_t b = 0; b < W; ++b) {
-      hin = myers_block_step(peq[static_cast<size_t>(b) * 256 + c], vp[b],
-                             vn[b], hin);
-    }
-    score += hin;
-  }
-
-  // Peel virtual rows below q_len off the final column.
-  for (int64_t r = 64ll * W - 1; r >= q_len; --r) {
-    const uint32_t b = static_cast<uint32_t>(r / 64);
-    const uint64_t bit = 1ull << (r % 64);
-    if (vp[b] & bit) {
-      --score;
-    } else if (vn[b] & bit) {
-      ++score;
+  // Ukkonen doubling: banded passes cost O(n*k/64) instead of the full
+  // O(n*m/64); a result <= k is exact. Typical long-read pairs resolve at
+  // k ~ 2*distance, several times cheaper than the full pass. Seeding at
+  // |m - n| skips passes that cannot possibly satisfy d <= k (distance is
+  // always >= the length difference).
+  const int64_t full = std::max(q_len, t_len);
+  const int64_t diff = std::llabs(static_cast<int64_t>(t_len) -
+                                  static_cast<int64_t>(q_len));
+  for (int64_t k = std::max<int64_t>(256, diff); k < full; k *= 4) {
+    const int64_t d = banded_distance_pass(peq, q_len, t, t_len, k, vp, vn);
+    if (d <= k) {
+      return d;
     }
   }
-  return score;
+
+  // Band covering everything == the classic full pass.
+  return banded_distance_pass(peq, q_len, t, t_len, full, vp, vn);
 }
 
 }  // namespace rt
