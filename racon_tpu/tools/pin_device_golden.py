@@ -1,0 +1,72 @@
+"""Measure the on-hardware λ device golden: run the PAF+qualities polishing
+scenario through the TPU backend (fused Pallas kernel) on the real chip and
+print the exact edit distance vs NC_001416.
+
+The reference pins its accelerator goldens next to the CPU ones
+(/root/reference/test/racon_test.cpp:316-318, GPU 1385 vs CPU 1312); this
+script produces the number we pin the same way in tests/test_golden.py.
+
+Usage:  python tools/pin_device_golden.py [scenario]
+Scenarios: paf (default) | sam | unit
+"""
+
+import gzip
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import racon_tpu
+from racon_tpu import native
+
+# same dataset location + override knob as tests/conftest.py (not imported:
+# this tool must not inherit the test suite's CPU-mesh forcing)
+DATA = os.environ.get("RACON_TPU_TEST_DATA", "/root/reference/test/data/")
+
+COMP = bytes.maketrans(b"ACGT", b"TGCA")
+
+
+def revcomp(b: bytes) -> bytes:
+    return b.translate(COMP)[::-1]
+
+
+def main():
+    scenario = sys.argv[1] if len(sys.argv) > 1 else "paf"
+    # keep in sync with tests/test_golden.py ARGS — the number this prints
+    # is only meaningful as the pin for that test's scenario
+    args = dict(window_length=500, quality_threshold=10.0,
+                error_threshold=0.3, match=5, mismatch=-4, gap=-8,
+                num_threads=1)
+    reads, ovl = "sample_reads.fastq.gz", "sample_overlaps.paf.gz"
+    if scenario == "sam":
+        ovl = "sample_overlaps.sam.gz"
+    elif scenario == "unit":
+        args.update(match=1, mismatch=-1, gap=-1)
+
+    with gzip.open(DATA + "sample_reference.fasta.gz", "rb") as f:
+        ref = b"".join(line.strip() for line in f if not
+                       line.startswith(b">"))
+
+    import jax
+    platform = jax.devices()[0].platform
+    if platform != "tpu":
+        # a CPU/interpret-mode number must never be mistaken for the
+        # hardware golden (the axon tunnel silently falls back when down)
+        sys.exit(f"refusing to measure: platform is {platform!r}, not tpu")
+
+    t0 = time.time()
+    p = racon_tpu.create_polisher(DATA + reads, DATA + ovl,
+                                  DATA + "sample_layout.fasta.gz",
+                                  backend="tpu", **args)
+    p.initialize()
+    res = p.polish(True)
+    dt = time.time() - t0
+    assert len(res) == 1, len(res)
+    ed = native.edit_distance(revcomp(res[0][1].encode()), ref)
+    print(f"platform={platform} scenario={scenario} device_golden_ed={ed} "
+          f"wall={dt:.1f}s")
+
+
+if __name__ == "__main__":
+    main()
