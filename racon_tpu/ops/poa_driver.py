@@ -206,13 +206,20 @@ def _n_devices() -> int:
 
 
 def _fits_vmem(cfg, budget_bytes: int = 14 << 20) -> bool:
-    """Whether the fused Pallas kernel's VMEM scratch fits the core budget."""
-    lp = (cfg.max_len + 1 + 127) // 128 * 128
-    h = (cfg.max_nodes + 1) * lp * 4
-    mv = (cfg.max_nodes + 1) * lp * 4   # move records, i32 (Mosaic tiling)
-    layers = 2 * cfg.depth * cfg.max_len * 4
-    graph = cfg.max_nodes * (4 * 4 + 2 * cfg.max_edges * 4)
-    return h + mv + layers + graph < budget_bytes
+    """Whether the fused Pallas kernel's VMEM scratch fits the core budget.
+
+    Mirrors poa_pallas.py's blocked layout: layer arrays live in HBM and
+    stream through two DMA slots, so depth does not appear here.
+    """
+    from .poa_pallas import blocked_width
+
+    jw8 = 8 * blocked_width(cfg.max_len + 1)
+    nw8 = 8 * blocked_width(cfg.max_nodes)
+    h = (cfg.max_nodes + 1) * jw8 * 4
+    mv = (cfg.max_nodes + 1) * jw8 * 4  # move records, i32 (Mosaic tiling)
+    layer_slots = 2 * 2 * jw8 * 4       # double-buffered seq + weight rows
+    graph = nw8 * (10 * 4 + 2 * cfg.max_edges * 4)
+    return h + mv + layer_slots + graph < budget_bytes
 
 
 def _build_kernel(cfg, B, use_pallas):

@@ -4,21 +4,40 @@ Same semantics as the reference JAX implementation in poa.py (which mirrors
 the host oracle rt_poa.cpp), but the entire per-window program — graph init,
 per-layer sequence-to-graph DP, traceback, graph update, heaviest-bundle
 consensus — runs as ONE kernel program per window (grid over the batch), with
-the DP matrix and all graph state resident in VMEM. This removes the
-per-step XLA while-loop overhead that dominates the pure-JAX version
-(~160us/step there; in-kernel loop iterations are orders of magnitude
-cheaper).
+the DP matrix and all graph state resident in VMEM.
 
-Key differences from poa.py, none semantic:
+Data layout (the v2 rework, after the first on-hardware measurements showed
+~115 ms/window): every logical 1-D row is stored **sublane-blocked** as an
+(8, W) tile with element i at (i // W, i % W) — so each vector op engages
+all 8 VPU sublanes instead of 1-of-8 as a (1, N) row would:
+
+  * DP/sequence rows (j in [0, L]):   (8, JW) — exactly one vreg at w=500
+  * node/rank state  (u in [0, N)):   (8, NW) — two vregs at w=500
+  * in-edge tables:                   (E, 8, NW), one dynamically indexed
+    (8, NW) sublane-row per slot (the v1 layout mask-reduced the whole
+    (E, N) array for every scalar edge read)
+
+Layer sequences/weights stay in HBM (memory_space=ANY); each layer is DMA'd
+into a double-buffered VMEM scratch slot while the previous layer's DP runs,
+so VMEM residency is independent of the depth bucket (the v1 layout's
+depth-200 bucket no longer threatens the ~16 MB core budget) and the copy
+rides under compute.
+
+Other deliberate choices, none semantic:
   * topological order is maintained incrementally (an O(N) vector
     shift-insert per new node) instead of argsort per layer; the subgraph is
-    then a contiguous rank range [count(key < lo), count(key <= hi)).
+    then a contiguous rank range [count(key < lo), min(count(key <= hi), n))
+    — the min() clamp matters for full-graph layers, whose hi sentinel
+    equals the unused-slot key sentinel and would otherwise sweep every
+    node slot.
   * end-node detection reuses the DP's predecessor enumeration (any
     in-subgraph edge marks its source as "has out-edge").
-  * the linear-gap cummax runs as log2(width) shift-max steps.
+  * the linear-gap cummax runs as lane-prefix + cross-sublane-prefix
+    shift-max steps.
 
-VMEM budget (w=500 config: N=1536, L=768): H (1537x896 i32) ~5.5 MB, layer
-inputs ~1.2 MB, graph arrays <1 MB — comfortably under the ~16 MB/core VMEM.
+VMEM budget (w=500 config: N=1536 -> NW=256, L=768 -> JW=128):
+H and MV (1537, 8, 128) i32 ~6.3 MB each, node/edge state <0.3 MB, staged
+layers 2 slots x 2 arrays x 4 KB — ~13 MB total for every depth bucket.
 """
 
 from __future__ import annotations
@@ -39,6 +58,11 @@ def _round_up(x, m):
     return (x + m - 1) // m * m
 
 
+def blocked_width(n: int) -> int:
+    """Lane width of the (8, W) sublane-blocked tile covering n elements."""
+    return _round_up((n + 7) // 8, 128)
+
+
 @functools.lru_cache(maxsize=32)
 def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
     N = cfg.max_nodes
@@ -46,7 +70,10 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
     BB = cfg.max_backbone
     E = cfg.max_edges
     D = cfg.depth
-    LP = _round_up(L + 1, 128)          # H row width (lanes)
+    JW = blocked_width(L + 1)           # j-dimension lanes per sublane row
+    NW = blocked_width(N)               # node/rank lanes per sublane row
+    SJ = 8 * JW                         # padded j capacity
+    SN = 8 * NW                         # padded node-slot capacity
     # plain Python scalars: captured jnp values would become kernel constants
     M = int(cfg.match)
     X = int(cfg.mismatch)
@@ -56,89 +83,115 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
     VSLOT = 15  # pred-slot sentinel meaning "virtual start row"
 
     def kernel(bb_len_ref, n_layers_ref, lens_ref, begins_ref, ends_ref,
-               bb_ref, bbw_ref, seqs_ref, ws_ref,
+               bb_ref, bbw_ref, seqs_hbm, ws_hbm,
                cons_base_ref, cons_cov_ref, cons_len_ref, failed_ref,
                n_nodes_ref,
                H, MV, base, key, cov, order, in_src, in_w, in_cnt,
                pos_node, nkey, runrem, score, pred, revbuf, has_out,
-               seq_scr, w_scr):
-        lane_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
-        lane_lp = jax.lax.broadcasted_iota(jnp.int32, (1, LP), 1)
-        lane_l = jax.lax.broadcasted_iota(jnp.int32, (1, L), 1)
-        en_rows = jax.lax.broadcasted_iota(jnp.int32, (E, N), 0)
-        en_cols = jax.lax.broadcasted_iota(jnp.int32, (E, N), 1)
-        gvec = lane_lp * G
+               seq_scr, w_scr, dma_sem):
+        jlane = jax.lax.broadcasted_iota(jnp.int32, (8, JW), 1)
+        jsub = jax.lax.broadcasted_iota(jnp.int32, (8, JW), 0)
+        jj = jsub * JW + jlane                      # j index per element
+        nlane = jax.lax.broadcasted_iota(jnp.int32, (8, NW), 1)
+        nsub = jax.lax.broadcasted_iota(jnp.int32, (8, NW), 0)
+        nn_i = nsub * NW + nlane                    # node/rank index
+        gvec = jj * G
 
         # Mosaic cannot store scalars to VMEM; every scalar store becomes a
-        # masked full-row read-modify-write (the rows are a handful of
-        # vregs, so this costs a few VPU ops per store).
-        def rmw1(ref, iota, idx, val):
-            ref[:] = jnp.where(iota == idx, val, ref[:])
+        # masked tile read-modify-write, and every dynamic-position scalar
+        # load a masked reduction. On the blocked layout each costs 1-2
+        # vregs of VPU work.
+        def rmwj(ref, idx, val):
+            ref[:] = jnp.where(jj == idx, val, ref[:])
 
-        def rmw2(ref, row, col, val):
-            ref[:] = jnp.where((en_rows == row) & (en_cols == col), val,
-                               ref[:])
+        def rmwn(ref, idx, val):
+            ref[:] = jnp.where(nn_i == idx, val, ref[:])
 
-        # ... and every dynamic-lane scalar load becomes a masked reduction
-        # (dynamic lane offsets must be 128-aligned on Mosaic; dynamic
-        # sublane offsets are fine, which the H/MV row accesses rely on).
-        def load1(ref, iota, idx):
-            row = ref[:]
-            return jnp.sum(jnp.where(iota == idx, row,
-                                     jnp.zeros_like(row)))
+        def loadj(tile, idx):
+            return jnp.sum(jnp.where(jj == idx, tile, jnp.zeros_like(tile)))
 
-        def load2(ref, row, col):
-            v = ref[:]
-            return jnp.sum(jnp.where((en_rows == row) & (en_cols == col), v,
-                                     jnp.zeros_like(v)))
+        def loadn(tile, idx):
+            return jnp.sum(jnp.where(nn_i == idx, tile,
+                                     jnp.zeros_like(tile)))
 
-        def load_lane(rowvec, iota, idx):
-            return jnp.sum(jnp.where(iota == idx, rowvec,
-                                     jnp.zeros_like(rowvec)))
+        # in-edge tables: one dynamically indexed sublane-row per slot
+        def eload(ref, e, u):
+            row = ref[pl.ds(e, 1)][0]
+            return jnp.sum(jnp.where(nn_i == u, row, jnp.zeros_like(row)))
+
+        def ermw(ref, e, u, val):
+            row = ref[pl.ds(e, 1)][0]
+            ref[pl.ds(e, 1)] = jnp.where(nn_i == u, val,
+                                         row).reshape(1, 8, NW)
+
+        def shift1(x, iota2, lane, fill):
+            # blocked shift: new[i] = old[i-1]; new[0] = fill
+            ln = pltpu.roll(x, 1, 1)
+            carry = pltpu.roll(ln, 1, 0)            # sublane roll
+            y = jnp.where(lane == 0, carry, ln)
+            return jnp.where(iota2 == 0, fill, y)
+
+        def cummaxj(x):
+            # prefix max over the blocked j line: lane prefix within each
+            # sublane row, then an exclusive cross-sublane prefix of the
+            # row maxima
+            k = 1
+            while k < JW:
+                x = jnp.maximum(
+                    x, jnp.where(jlane >= k, pltpu.roll(x, k, 1), NEG))
+                k *= 2
+            tot = jnp.max(x, axis=1, keepdims=True)  # (8, 1) row maxima
+            p = jnp.broadcast_to(tot, (8, JW))
+            k = 1
+            while k < 8:
+                p = jnp.maximum(
+                    p, jnp.where(jsub >= k, pltpu.roll(p, k, 0), NEG))
+                k *= 2
+            excl = jnp.where(jsub >= 1, pltpu.roll(p, 1, 0), NEG)
+            return jnp.maximum(x, excl)
 
         bb_len = bb_len_ref[0, 0, 0]
         n_layers = n_layers_ref[0, 0, 0]
+        b_prog = pl.program_id(0)
 
-        def padcat(row, width, fill):
-            # static right-pad to `width` lanes (Mosaic has no scatter;
-            # concatenate lowers cleanly)
-            w = row.shape[1]
-            if w == width:
-                return row
-            return jnp.concatenate(
-                [row, jnp.full((1, width - w), fill, row.dtype)], axis=1)
+        def start_copy(li, slot):
+            pltpu.make_async_copy(seqs_hbm.at[b_prog, li],
+                                  seq_scr.at[slot],
+                                  dma_sem.at[slot, 0]).start()
+            pltpu.make_async_copy(ws_hbm.at[b_prog, li],
+                                  w_scr.at[slot],
+                                  dma_sem.at[slot, 1]).start()
+
+        def wait_copy(li, slot):
+            pltpu.make_async_copy(seqs_hbm.at[b_prog, li],
+                                  seq_scr.at[slot],
+                                  dma_sem.at[slot, 0]).wait()
+            pltpu.make_async_copy(ws_hbm.at[b_prog, li],
+                                  w_scr.at[slot],
+                                  dma_sem.at[slot, 1]).wait()
 
         # ---- graph init from the backbone chain --------------------------
-        bbrow = bb_ref[0]                                   # (1, BB)
-        bbpad = padcat(bbrow, N, -1)
-        used0 = lane_n < bb_len
-        base[:] = jnp.where(used0, bbpad, -1)
-        key[:] = jnp.where(used0, lane_n.astype(jnp.float32), KEY_INF)
+        bbblk = bb_ref[0]                           # (8, NW), node-blocked
+        used0 = nn_i < bb_len
+        base[:] = jnp.where(used0, bbblk, -1)
+        key[:] = jnp.where(used0, nn_i.astype(jnp.float32), KEY_INF)
         cov[:] = jnp.where(used0, 1, 0)
-        order[:] = lane_n
-        bbw_row = bbw_ref[0]
-        bbw_pad = padcat(bbw_row, N, 0)
-        chain = (lane_n > 0) & used0
-        in_src[:] = jnp.full((E, N), -1, jnp.int32)
-        in_src[0:1, :] = jnp.where(chain, lane_n - 1, -1)
-        in_w[:] = jnp.zeros((E, N), jnp.int32)
-        in_w[0:1, :] = jnp.where(chain,
-                                 pltpu.roll(bbw_pad, 1, 1) + bbw_pad, 0)
+        order[:] = nn_i
+        bbw_blk = bbw_ref[0]
+        chain = (nn_i > 0) & used0
+        in_src[:] = jnp.full((E, 8, NW), -1, jnp.int32)
+        in_src[0:1] = jnp.where(chain, nn_i - 1, -1).reshape(1, 8, NW)
+        in_w[:] = jnp.zeros((E, 8, NW), jnp.int32)
+        in_w[0:1] = jnp.where(
+            chain, shift1(bbw_blk, nn_i, nlane, 0) + bbw_blk,
+            0).reshape(1, 8, NW)
         # edge slots fill contiguously from 0, so in_cnt doubles as "first
         # empty slot" and bounds every per-node slot loop to the true degree
         in_cnt[:] = jnp.where(chain, 1, 0)
-        H[0:1, :] = gvec
-
-        def cummax_lanes(x):
-            k = 1
-            while k < LP:
-                sh = jnp.where(lane_lp >= k, pltpu.roll(x, k, 1), NEG)
-                x = jnp.maximum(x, sh)
-                k *= 2
-            return x
+        H[0:1] = gvec.reshape(1, 8, JW)
 
         # ---- one layer ----------------------------------------------------
-        def do_layer(li, carry):
+        def do_layer(li, slot, carry):
             n, failed = carry
             Ln = lens_ref[0, 0, li]
             begin = begins_ref[0, 0, li]
@@ -147,63 +200,64 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             # full-graph rule (reference: src/window.cpp:88-97)
             offset = (0.01 * bb_len.astype(jnp.float32)).astype(jnp.int32)
             full = (begin < offset) & (end > bb_len - offset)
-            lo = jnp.where(full, jnp.float32(-3.0e38), begin.astype(jnp.float32))
+            lo = jnp.where(full, jnp.float32(-3.0e38),
+                           begin.astype(jnp.float32))
             hi = jnp.where(full, jnp.float32(3.0e38), end.astype(jnp.float32))
 
-            # stage the layer into scratch
-            seq_scr[:] = padcat(seqs_ref[0, pl.ds(li, 1), :], LP, 255)
-            w_scr[:] = padcat(ws_ref[0, pl.ds(li, 1), :], LP, 0)
+            seqv = seq_scr[pl.ds(slot, 1)][0]        # (8, JW)
+            wv = w_scr[pl.ds(slot, 1)][0]
 
             keys = key[:]
             r_lo = jnp.sum(jnp.where(keys < lo, 1, 0)).astype(jnp.int32)
-            r_hi = jnp.sum(jnp.where(keys <= hi, 1, 0)).astype(jnp.int32)
+            # clamp to n: for full layers hi == the unused-slot sentinel
+            r_hi = jnp.minimum(
+                jnp.sum(jnp.where(keys <= hi, 1, 0)).astype(jnp.int32), n)
 
-            has_out[:] = jnp.zeros((1, N), jnp.int32)
+            has_out[:] = jnp.zeros((8, NW), jnp.int32)
 
-            seqv = seq_scr[:]
-            seqm1 = pltpu.roll(seqv, 1, 1)
+            seqm1 = shift1(seqv, jj, jlane, 255)
 
             # ---- DP over subgraph nodes in rank order ---------------------
             # Per-cell move records (2 bits move + pred slot, VSLOT =
             # virtual) land in MV so the traceback is one load per step.
             def dp_body(r, _):
-                u = load1(order, lane_n, r)
-                ub = load1(base, lane_n, u)
+                u = loadn(order[:], r)
+                ub = loadn(base[:], u)
 
                 def pred_scan(e, c):
                     P, Pslot, any_valid = c
-                    src = load2(in_src, e, u)
-                    ok = load1(key, lane_n, jnp.maximum(src, 0)) >= lo
-                    prow = H[pl.ds(jnp.maximum(src, 0) + 1, 1), :]
+                    src = eload(in_src, e, u)
+                    ok = loadn(key[:], jnp.maximum(src, 0)) >= lo
+                    prow = H[pl.ds(jnp.maximum(src, 0) + 1, 1)][0]
                     better = ok & (prow > P)  # strict: first max slot wins
                     P = jnp.where(better, prow, P)
                     Pslot = jnp.where(better, e, Pslot)
 
                     @pl.when(ok)
                     def _():
-                        rmw1(has_out, lane_n, jnp.maximum(src, 0), 1)
+                        rmwn(has_out, jnp.maximum(src, 0), 1)
                     return (P, Pslot, any_valid | ok)
 
-                P0 = jnp.full((1, LP), NEG, jnp.int32)
-                S0 = jnp.full((1, LP), VSLOT, jnp.int32)
+                P0 = jnp.full((8, JW), NEG, jnp.int32)
+                S0 = jnp.full((8, JW), VSLOT, jnp.int32)
                 P, Pslot, any_valid = jax.lax.fori_loop(
-                    0, load1(in_cnt, lane_n, u), pred_scan,
+                    0, loadn(in_cnt[:], u), pred_scan,
                     (P0, S0, jnp.bool_(False)))
-                P = jnp.where(any_valid, P, H[pl.ds(0, 1), :])
+                P = jnp.where(any_valid, P, H[0:1][0])
                 Pslot = jnp.where(any_valid, Pslot, VSLOT)
 
                 scvec = jnp.where(seqm1 == ub, M, X)
-                Psh = jnp.where(lane_lp >= 1, pltpu.roll(P, 1, 1), NEG)
-                Ssh = jnp.where(lane_lp >= 1, pltpu.roll(Pslot, 1, 1), VSLOT)
+                Psh = shift1(P, jj, jlane, NEG)
+                Ssh = shift1(Pslot, jj, jlane, VSLOT)
                 diag = Psh + scvec
                 up = P + G
                 choose_diag = diag >= up  # host priority: diag before up
                 V = jnp.where(choose_diag, diag, up)
                 vmove = jnp.where(choose_diag, 4 * Ssh, 1 + 4 * Pslot)
-                row = cummax_lanes(V - gvec) + gvec
+                row = cummaxj(V - gvec) + gvec
                 mv = jnp.where(row > V, 2, vmove)  # left only if strictly better
-                H[pl.ds(u + 1, 1), :] = row
-                MV[pl.ds(u + 1, 1), :] = mv
+                H[pl.ds(u + 1, 1)] = row.reshape(1, 8, JW)
+                MV[pl.ds(u + 1, 1)] = mv.reshape(1, 8, JW)
                 return 0
 
             jax.lax.fori_loop(r_lo, r_hi, dp_body, 0)
@@ -211,9 +265,9 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             # ---- best end node (first max in rank order) ------------------
             def end_body(r, c):
                 best_u, best_s = c
-                u = load1(order, lane_n, r)
-                is_end = load1(has_out, lane_n, u) == 0
-                s = load_lane(H[pl.ds(u + 1, 1), :], lane_lp, Ln)
+                u = loadn(order[:], r)
+                is_end = loadn(has_out[:], u) == 0
+                s = loadj(H[pl.ds(u + 1, 1)][0], Ln)
                 better = is_end & (s > best_s)
                 return (jnp.where(better, u, best_u),
                         jnp.where(better, s, best_s))
@@ -223,7 +277,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 (jnp.int32(-1), jnp.int32(NEG)))
 
             # ---- traceback -------------------------------------------------
-            pos_node[:] = jnp.full((1, L), -1, jnp.int32)
+            pos_node[:] = jnp.full((8, JW), -1, jnp.int32)
 
             def tb_cond(c):
                 u, j, steps, ok = c
@@ -234,19 +288,19 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 at_virtual = u == -1
                 uc = jnp.maximum(u, 0)
                 jm1 = jnp.maximum(j - 1, 0)
-                mv_loaded = load_lane(MV[pl.ds(uc + 1, 1), :], lane_lp, j)
+                mv_loaded = loadj(MV[pl.ds(uc + 1, 1)][0], j)
                 mv = jnp.where(at_virtual, 2, mv_loaded)
                 move = mv % 4
                 slot = mv // 4
                 slot_c = jnp.minimum(slot, E - 1)
-                prd = jnp.where(slot == VSLOT, -1, load2(in_src, slot_c, uc))
+                prd = jnp.where(slot == VSLOT, -1, eload(in_src, slot_c, uc))
 
                 take_diag = ~at_virtual & (move == 0)
                 take_up = ~at_virtual & (move == 1)
 
                 @pl.when(take_diag)
                 def _():
-                    rmw1(pos_node, lane_l, jm1, u)
+                    rmwj(pos_node, jm1, u)
 
                 new_u = jnp.where(take_diag | take_up, prd, u)
                 new_j = jnp.where(take_up, j, j - 1)
@@ -261,12 +315,12 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             def back_body(i, c):
                 nk, run = c
                 j = Ln - 1 - i
-                pn = load1(pos_node, lane_l, j)
+                pn = loadj(pos_node[:], j)
                 m = pn >= 0
-                nk = jnp.where(m, load1(key, lane_n, jnp.maximum(pn, 0)), nk)
+                nk = jnp.where(m, loadn(key[:], jnp.maximum(pn, 0)), nk)
                 run = jnp.where(m, 0, run + 1)
-                rmw1(nkey, lane_l, j, nk)
-                rmw1(runrem, lane_l, j, run)
+                rmwj(nkey, j, nk)
+                rmwj(runrem, j, run)
                 return (nk, run)
 
             jax.lax.fori_loop(0, Ln, back_body,
@@ -275,19 +329,19 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             # ---- graph update ----------------------------------------------
             def upd_body(j, c):
                 n, failed, prev, prev_key, prev_w = c
-                b = load1(seq_scr, lane_lp, j)
-                wj = load1(w_scr, lane_lp, j)
-                pn = load1(pos_node, lane_l, j)
+                b = loadj(seqv, j)
+                wj = loadj(wv, j)
+                pn = loadj(pos_node[:], j)
                 is_match = pn >= 0
-                k0 = load1(key, lane_n, jnp.maximum(pn, 0))
+                k0 = loadn(key[:], jnp.maximum(pn, 0))
 
                 keys = key[:]
                 cand = (keys == k0) & (base[:] == b)
                 has = cand.any() & is_match
-                found = jnp.min(jnp.where(cand, lane_n, N)).astype(jnp.int32)
+                found = jnp.min(jnp.where(cand, nn_i, SN)).astype(jnp.int32)
 
-                nk = load1(nkey, lane_l, j)
-                run = load1(runrem, lane_l, j).astype(jnp.float32)
+                nk = loadj(nkey[:], j)
+                run = loadj(runrem[:], j).astype(jnp.float32)
                 hi2 = jnp.where(nk < KEY_INF, nk, prev_key + 1.0)
                 lo2 = jnp.where(prev >= 0, prev_key, hi2 - run - 1.0)
                 k_new = lo2 + (hi2 - lo2) / (run + 1.0)
@@ -303,19 +357,19 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                     # insert into sorted order: after all keys <= key_val
                     p = jnp.sum(jnp.where(keys <= key_val, 1, 0)).astype(
                         jnp.int32)
-                    rmw1(base, lane_n, nid, b)
-                    rmw1(key, lane_n, nid, key_val)
+                    rmwn(base, nid, b)
+                    rmwn(key, nid, key_val)
                     ordv = order[:]
-                    shifted = pltpu.roll(ordv, 1, 1)
+                    shifted = shift1(ordv, nn_i, nlane, 0)
                     order[:] = jnp.where(
-                        lane_n < p, ordv,
-                        jnp.where(lane_n == p, nid, shifted))
+                        nn_i < p, ordv,
+                        jnp.where(nn_i == p, nid, shifted))
 
                 touch = ~overflow
 
                 @pl.when(touch)
                 def _():
-                    rmw1(cov, lane_n, nid, load1(cov, lane_n, nid) + 1)
+                    rmwn(cov, nid, loadn(cov[:], nid) + 1)
 
                 n = n + jnp.where(do_new, 1, 0)
                 failed = failed | overflow
@@ -325,11 +379,11 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
                 def eslot_scan(e, c2):
                     same_slot = c2
-                    src = load2(in_src, e, nid)
+                    src = eload(in_src, e, nid)
                     return jnp.where((src == prev) & (same_slot < 0), e,
                                      same_slot)
 
-                cnt = load1(in_cnt, lane_n, nid)
+                cnt = loadn(in_cnt[:], nid)
                 same_slot = jax.lax.fori_loop(
                     0, cnt, eslot_scan, jnp.int32(-1))
                 empty_slot = jnp.where(cnt < E, cnt, -1)
@@ -337,28 +391,40 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
                 @pl.when(has_prev & (same_slot >= 0))
                 def _():
-                    rmw2(in_w, same_slot, nid,
-                         load2(in_w, same_slot, nid) + ew)
+                    ermw(in_w, same_slot, nid,
+                         eload(in_w, same_slot, nid) + ew)
 
                 @pl.when(has_prev & (same_slot < 0) & (empty_slot >= 0))
                 def _():
-                    rmw2(in_src, empty_slot, nid, prev)
-                    rmw2(in_w, empty_slot, nid, ew)
-                    rmw1(in_cnt, lane_n, nid, cnt + 1)
+                    ermw(in_src, empty_slot, nid, prev)
+                    ermw(in_w, empty_slot, nid, ew)
+                    rmwn(in_cnt, nid, cnt + 1)
 
                 failed = failed | (has_prev & (same_slot < 0) &
                                    (empty_slot < 0))
-                return (n, failed, nid, load1(key, lane_n, nid), wj)
+                return (n, failed, nid, loadn(key[:], nid), wj)
 
             n, failed, _, _, _ = jax.lax.fori_loop(
                 0, Ln, upd_body,
                 (n, failed, jnp.int32(-1), jnp.float32(-1.0), jnp.int32(0)))
             return (n, failed)
 
+        @pl.when(n_layers > 0)
+        def _():
+            start_copy(0, 0)
+
         def layer_loop(li, carry):
             n, failed = carry
+            slot = jax.lax.rem(li, 2)
+            wait_copy(li, slot)
+
+            @pl.when(li + 1 < n_layers)
+            def _():
+                # prefetch the next layer while this one's DP runs
+                start_copy(li + 1, jax.lax.rem(li + 1, 2))
+
             run = (lens_ref[0, 0, li] > 0) & ~failed
-            return jax.lax.cond(run, lambda c: do_layer(li, c),
+            return jax.lax.cond(run, lambda c: do_layer(li, slot, c),
                                 lambda c: c, (n, failed))
 
         n, failed = jax.lax.fori_loop(
@@ -367,23 +433,23 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
         # ---- consensus -----------------------------------------------------
         def score_body(r, c):
             best_u, best_s = c
-            u = load1(order, lane_n, r)
+            u = loadn(order[:], r)
 
             def slot_scan(e, c2):
                 bw, bs, bp = c2
-                src = load2(in_src, e, u)
-                w = load2(in_w, e, u)
-                s = load1(score, lane_n, jnp.maximum(src, 0))
+                src = eload(in_src, e, u)
+                w = eload(in_w, e, u)
+                s = loadn(score[:], jnp.maximum(src, 0))
                 better = (w > bw) | ((w == bw) & (s > bs))
                 return (jnp.where(better, w, bw), jnp.where(better, s, bs),
                         jnp.where(better, src, bp))
 
             bw, bs, bp = jax.lax.fori_loop(
-                0, load1(in_cnt, lane_n, u), slot_scan,
+                0, loadn(in_cnt[:], u), slot_scan,
                 (jnp.int32(NEG), jnp.int32(NEG), jnp.int32(-1)))
             s = jnp.where(bp >= 0, bw + bs, 0)
-            rmw1(score, lane_n, u, s)
-            rmw1(pred, lane_n, u, bp)
+            rmwn(score, u, s)
+            rmwn(pred, u, bp)
             better = s > best_s
             return (jnp.where(better, u, best_u), jnp.maximum(s, best_s))
 
@@ -397,22 +463,22 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
         def bbody(c):
             u, cnt = c
-            rmw1(revbuf, lane_n, cnt, u)
-            return (load1(pred, lane_n, u), cnt + 1)
+            rmwn(revbuf, cnt, u)
+            return (loadn(pred[:], u), cnt + 1)
 
         _, cnt_b = jax.lax.while_loop(bcond, bbody, (summit, jnp.int32(0)))
 
-        cons_base_ref[0] = jnp.full((1, N), -1, jnp.int32)
-        cons_cov_ref[0] = jnp.zeros((1, N), jnp.int32)
+        cons_base_ref[0] = jnp.full((8, NW), -1, jnp.int32)
+        cons_cov_ref[0] = jnp.zeros((8, NW), jnp.int32)
 
         def emit(i, u):
-            cons_base_ref[0] = jnp.where(lane_n == i, load1(base, lane_n, u),
+            cons_base_ref[0] = jnp.where(nn_i == i, loadn(base[:], u),
                                          cons_base_ref[0])
-            cons_cov_ref[0] = jnp.where(lane_n == i, load1(cov, lane_n, u),
+            cons_cov_ref[0] = jnp.where(nn_i == i, loadn(cov[:], u),
                                         cons_cov_ref[0])
 
         def flip_body(i, _):
-            emit(i, load1(revbuf, lane_n, cnt_b - 1 - i))
+            emit(i, loadn(revbuf[:], cnt_b - 1 - i))
             return 0
 
         jax.lax.fori_loop(0, cnt_b, flip_body, 0)
@@ -424,14 +490,14 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
         def fbody(c):
             u, cnt, _ = c
-            ew = jnp.where(in_src[:] == u, in_w[:], NEG)      # (E, N)
-            wv = jnp.max(ew, axis=0, keepdims=True)           # (1, N)
-            any_out = jnp.max(wv) > NEG
-            wmax = jnp.max(wv)
+            ew = jnp.where(in_src[:] == u, in_w[:], NEG)      # (E, 8, NW)
+            wv2 = jnp.max(ew, axis=0)                         # (8, NW)
+            any_out = jnp.max(wv2) > NEG
+            wmax = jnp.max(wv2)
             scorev = score[:]
-            cand_s = jnp.where(wv == wmax, scorev, NEG)
+            cand_s = jnp.where(wv2 == wmax, scorev, NEG)
             smax = jnp.max(cand_s)
-            v = jnp.min(jnp.where(cand_s == smax, lane_n, N)).astype(
+            v = jnp.min(jnp.where(cand_s == smax, nn_i, SN)).astype(
                 jnp.int32)
 
             @pl.when(any_out)
@@ -450,50 +516,48 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
     def make(batch: int):
         # Mosaic block rules: last two block dims must tile (8,128) or equal
-        # the array dims. A leading singleton makes the grid dim the only
-        # blocked dim, so per-program blocks satisfy the rule in both SMEM
-        # (scalars) and VMEM (rows); SMEM residency stays O(D), not O(B*D).
+        # the array dims; the blocked tiles satisfy this natively. SMEM
+        # residency stays O(D), not O(B*D); layer arrays live in HBM (ANY)
+        # and are DMA'd per layer.
         smem3 = lambda w: pl.BlockSpec((1, 1, w), lambda b: (b, 0, 0),
                                        memory_space=pltpu.SMEM)
-        vmem3w = lambda w: pl.BlockSpec((1, 1, w), lambda b: (b, 0, 0),
-                                        memory_space=pltpu.VMEM)
-        vmem3 = lambda: pl.BlockSpec((1, D, L), lambda b: (b, 0, 0),
-                                     memory_space=pltpu.VMEM)
+        vblk = pl.BlockSpec((1, 8, NW), lambda b: (b, 0, 0),
+                            memory_space=pltpu.VMEM)
+        hbm = pl.BlockSpec(memory_space=pl.ANY)
 
         return pl.pallas_call(
             kernel,
             grid=(batch,),
             in_specs=[smem3(1), smem3(1), smem3(D), smem3(D), smem3(D),
-                      vmem3w(BB), vmem3w(BB), vmem3(), vmem3()],
-            out_specs=[vmem3w(N), vmem3w(N), smem3(1), smem3(1), smem3(1)],
+                      vblk, vblk, hbm, hbm],
+            out_specs=[vblk, vblk, smem3(1), smem3(1), smem3(1)],
             out_shape=[
-                jax.ShapeDtypeStruct((batch, 1, N), jnp.int32),
-                jax.ShapeDtypeStruct((batch, 1, N), jnp.int32),
+                jax.ShapeDtypeStruct((batch, 8, NW), jnp.int32),
+                jax.ShapeDtypeStruct((batch, 8, NW), jnp.int32),
                 jax.ShapeDtypeStruct((batch, 1, 1), jnp.int32),
                 jax.ShapeDtypeStruct((batch, 1, 1), jnp.int32),
                 jax.ShapeDtypeStruct((batch, 1, 1), jnp.int32),
             ],
             scratch_shapes=[
-                pltpu.VMEM((N + 1, LP), jnp.int32),    # H
-                # i32, not i8: packed i8 sublanes can't be dynamically
-                # row-indexed on Mosaic (offset must be a multiple of 4)
-                pltpu.VMEM((N + 1, LP), jnp.int32),    # MV (move records)
-                pltpu.VMEM((1, N), jnp.int32),         # base
-                pltpu.VMEM((1, N), jnp.float32),       # key
-                pltpu.VMEM((1, N), jnp.int32),         # cov
-                pltpu.VMEM((1, N), jnp.int32),         # order
-                pltpu.VMEM((E, N), jnp.int32),         # in_src
-                pltpu.VMEM((E, N), jnp.int32),         # in_w
-                pltpu.VMEM((1, N), jnp.int32),         # in_cnt
-                pltpu.VMEM((1, L), jnp.int32),         # pos_node
-                pltpu.VMEM((1, L), jnp.float32),       # nkey
-                pltpu.VMEM((1, L), jnp.int32),         # runrem
-                pltpu.VMEM((1, N), jnp.int32),         # score
-                pltpu.VMEM((1, N), jnp.int32),         # pred
-                pltpu.VMEM((1, N), jnp.int32),         # revbuf
-                pltpu.VMEM((1, N), jnp.int32),         # has_out
-                pltpu.VMEM((1, LP), jnp.int32),        # seq_scr
-                pltpu.VMEM((1, LP), jnp.int32),        # w_scr
+                pltpu.VMEM((N + 1, 8, JW), jnp.int32),  # H
+                pltpu.VMEM((N + 1, 8, JW), jnp.int32),  # MV (move records)
+                pltpu.VMEM((8, NW), jnp.int32),         # base
+                pltpu.VMEM((8, NW), jnp.float32),       # key
+                pltpu.VMEM((8, NW), jnp.int32),         # cov
+                pltpu.VMEM((8, NW), jnp.int32),         # order
+                pltpu.VMEM((E, 8, NW), jnp.int32),      # in_src
+                pltpu.VMEM((E, 8, NW), jnp.int32),      # in_w
+                pltpu.VMEM((8, NW), jnp.int32),         # in_cnt
+                pltpu.VMEM((8, JW), jnp.int32),         # pos_node
+                pltpu.VMEM((8, JW), jnp.float32),       # nkey
+                pltpu.VMEM((8, JW), jnp.int32),         # runrem
+                pltpu.VMEM((8, NW), jnp.int32),         # score
+                pltpu.VMEM((8, NW), jnp.int32),         # pred
+                pltpu.VMEM((8, NW), jnp.int32),         # revbuf
+                pltpu.VMEM((8, NW), jnp.int32),         # has_out
+                pltpu.VMEM((2, 8, JW), jnp.int32),      # seq_scr (2 slots)
+                pltpu.VMEM((2, 8, JW), jnp.int32),      # w_scr
+                pltpu.SemaphoreType.DMA((2, 2)),        # per (slot, array)
             ],
             interpret=interpret,
         )
@@ -503,13 +567,22 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
         call = make(batch)
 
         def fn(bb_len, n_layers, lens, begins, ends, bb, bbw, seqs, ws):
+            # host-shaped inputs -> sublane-blocked tiles (XLA relayouts
+            # on device; the pallas kernel sees native (8, W) tiles)
+            bbB = jnp.pad(bb.reshape(batch, BB),
+                          ((0, 0), (0, SN - BB))).reshape(batch, 8, NW)
+            bbwB = jnp.pad(bbw.reshape(batch, BB),
+                           ((0, 0), (0, SN - BB))).reshape(batch, 8, NW)
+            seqsB = jnp.pad(seqs, ((0, 0), (0, 0), (0, SJ - L)),
+                            constant_values=255).reshape(batch, D, 8, JW)
+            wsB = jnp.pad(ws, ((0, 0), (0, 0), (0, SJ - L))
+                          ).reshape(batch, D, 8, JW)
             cb, cc, cl, fl, nn = call(
                 bb_len.reshape(batch, 1, 1), n_layers.reshape(batch, 1, 1),
                 lens.reshape(batch, 1, D), begins.reshape(batch, 1, D),
-                ends.reshape(batch, 1, D),
-                bb.reshape(batch, 1, BB), bbw.reshape(batch, 1, BB),
-                seqs, ws)
-            return (cb.reshape(batch, N), cc.reshape(batch, N),
+                ends.reshape(batch, 1, D), bbB, bbwB, seqsB, wsB)
+            return (cb.reshape(batch, SN)[:, :N],
+                    cc.reshape(batch, SN)[:, :N],
                     cl.reshape(batch, 1), fl.reshape(batch, 1),
                     nn.reshape(batch, 1))
 
