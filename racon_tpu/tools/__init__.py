@@ -3,3 +3,20 @@
 and paired-end read preprocessing. Capability parity with the reference's
 scripts/ + vendored rampler (/root/reference/scripts/racon_wrapper.py,
 racon_preprocess.py, vendor/rampler)."""
+
+
+def force_cpu_if_requested() -> None:
+    """Honor RACON_TPU_FORCE_CPU=1 before any jax backend initializes.
+
+    The axon TPU plugin ignores the JAX_PLATFORMS env var and its backend
+    init hangs indefinitely on a wedged tunnel; the config knob is what
+    actually wins, and only if it runs before the first jax.devices().
+    Measurement tools call this first so they can be pointed at the CPU
+    backend while the tunnel is down.
+    """
+    import os
+
+    if os.environ.get("RACON_TPU_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

@@ -15,6 +15,12 @@ mode, and times each:
           right shape) — isolates the cost of pltpu.roll(axis=0)
   mode 6: mode 0 on a flat (1, 8*JW) row layout (lane rolls only, 8x the
           vregs per op) — the v1-style row to compare against
+  mode 7: mode 0 with radix-4 lane / radix-8 sublane scans — same work,
+          ~half the dependency-chain depth (tests the latency-bound
+          hypothesis)
+  mode 8: mode 0 on PAIRED rows (2, 8, JW): two independent DP chains per
+          iteration in double-width ops — tests pipeline ILP from wider
+          vregs (per_node accounts for the 2x rows)
 
 mode 4 approximates the full dp_body. The deltas between modes say which
 component to attack next; per-node microseconds are printed for each.
@@ -75,15 +81,36 @@ def build(mode: int, R: int, B: int, interpret: bool):
             return jnp.where(jj == 0, fill, y)
 
         def cummaxj(x):
-            k = 1
-            while k < JW:
-                x = jnp.maximum(
-                    x, jnp.where(jlane >= k, pltpu.roll(x, k, 1), NEG))
-                k *= 2
+            if mode == 7:
+                # radix-4 lane prefix: 4 rounds of 3 independent shifted
+                # copies (shallower dependency chain than 7 binary rounds)
+                w = 1
+                while w < JW:
+                    shs = [jnp.where(jlane >= k * w,
+                                     pltpu.roll(x, k * w, 1), NEG)
+                           for k in (1, 2, 3) if k * w < JW]
+                    for sh in shs:
+                        x = jnp.maximum(x, sh)
+                    w *= 4
+            else:
+                k = 1
+                while k < JW:
+                    x = jnp.maximum(
+                        x, jnp.where(jlane >= k, pltpu.roll(x, k, 1), NEG))
+                    k *= 2
             if mode == 5:
                 return x
             tot = jnp.max(x, axis=1, keepdims=True)
-            p = jnp.broadcast_to(tot, (8, JW))
+            p = jnp.broadcast_to(tot, x.shape)
+            if mode == 7:
+                # radix-8 sublane prefix: 7 independent shifted copies
+                shs = [jnp.where(jsub >= k, pltpu.roll(p, k, 0), NEG)
+                       for k in range(1, 8)]
+                e = NEG * jnp.ones_like(p)
+                for sh in shs:
+                    e = jnp.maximum(e, sh)
+                excl = jnp.where(jsub >= 1, e, NEG)
+                return jnp.maximum(x, excl)
             k = 1
             while k < 8:
                 p = jnp.maximum(
@@ -126,6 +153,49 @@ def build(mode: int, R: int, B: int, interpret: bool):
             out_ref[0, 0, 0] = H[pl.ds(R, 1)][0][0, 0]
             return
 
+        if mode == 8:
+            psub = jax.lax.broadcasted_iota(jnp.int32, (2, 8, JW), 1)
+            plane = jax.lax.broadcasted_iota(jnp.int32, (2, 8, JW), 2)
+            jj2 = psub * JW + plane
+            gp = jj2 * G
+            H[0:1] = (gp + seed_ref[0, 0, 0]).reshape(1, 2, 8, JW)
+
+            def shift1_pair(x, fill):
+                ln = pltpu.roll(x, 1, 2)
+                carry = pltpu.roll(ln, 1, 1)
+                y = jnp.where(plane == 0, carry, ln)
+                return jnp.where(jj2 == 0, fill, y)
+
+            def cummax_pair(x):
+                k = 1
+                while k < JW:
+                    x = jnp.maximum(
+                        x, jnp.where(plane >= k, pltpu.roll(x, k, 2), NEG))
+                    k *= 2
+                tot = jnp.max(x, axis=2, keepdims=True)
+                p = jnp.broadcast_to(tot, x.shape)
+                k = 1
+                while k < 8:
+                    p = jnp.maximum(
+                        p, jnp.where(psub >= k, pltpu.roll(p, k, 1), NEG))
+                    k *= 2
+                excl = jnp.where(psub >= 1, pltpu.roll(p, 1, 1), NEG)
+                return jnp.maximum(x, excl)
+
+            def dp_pair(r, _):
+                P = H[pl.ds(r, 1)][0]                  # (2, 8, JW)
+                scvec = jnp.where(jj2 % 4 == 1, 5, -4)
+                diag = shift1_pair(P, NEG) + scvec
+                up = P + G
+                V = jnp.where(diag >= up, diag, up)
+                row = cummax_pair(V - gp) + gp
+                H[pl.ds(r + 1, 1)] = row.reshape(1, 2, 8, JW)
+                return 0
+
+            jax.lax.fori_loop(0, R, dp_pair, 0)
+            out_ref[0, 0, 0] = H[pl.ds(R, 1)][0][0, 0, 0]
+            return
+
         # graph state init (content irrelevant; loads must be real)
         order[:] = nn_i
         base[:] = nn_i % 4
@@ -138,19 +208,23 @@ def build(mode: int, R: int, B: int, interpret: bool):
         # runtime seed keeps XLA from constant-folding the whole call
         H[0:1] = (gvec + seed_ref[0, 0, 0]).reshape(1, 8, JW)
 
+        # modes 5 and 7 are row-math variants of mode 0: no graph-state
+        # machinery, or their deltas vs mode 0 would be confounded
+        level = 0 if mode in (5, 7) else mode
+
         def dp(r, _):
-            if mode >= 1:
+            if level >= 1:
                 u = loadn(order[:], r)
             else:
                 u = r
-            if mode >= 2:
+            if level >= 2:
                 ub = loadn(base[:], u)
                 cnt = loadn(in_cnt[:], u)
             else:
                 ub = jnp.int32(1)
                 cnt = jnp.int32(0)
 
-            if mode >= 3:
+            if level >= 3:
                 def pred_scan(e, c):
                     P, any_valid = c
                     src = eload(in_src, e, u)
@@ -158,7 +232,7 @@ def build(mode: int, R: int, B: int, interpret: bool):
                     prow = H[pl.ds(jnp.maximum(src, 0) + 1, 1)][0]
                     better = ok & (prow > P)
                     P = jnp.where(better, prow, P)
-                    if mode >= 4:
+                    if level >= 4:
                         @pl.when(ok)
                         def _():
                             has_out[:] = jnp.where(
@@ -166,8 +240,11 @@ def build(mode: int, R: int, B: int, interpret: bool):
                     return (P, any_valid | ok)
 
                 P0 = jnp.full((8, JW), NEG, jnp.int32)
-                P, _ = jax.lax.fori_loop(0, cnt, pred_scan,
-                                         (P0, jnp.bool_(False)))
+                P, any_valid = jax.lax.fori_loop(0, cnt, pred_scan,
+                                                 (P0, jnp.bool_(False)))
+                # virtual-row fallback, as in the real kernel — without it
+                # zero-pred nodes saturate the whole chain to NEG
+                P = jnp.where(any_valid, P, H[0:1][0])
             else:
                 P = H[pl.ds(jnp.maximum(u, 0), 1)][0]
 
@@ -181,7 +258,10 @@ def build(mode: int, R: int, B: int, interpret: bool):
             return 0
 
         jax.lax.fori_loop(0, R, dp, 0)
-        out_ref[0, 0, 0] = H[pl.ds(R, 1)][0][0, 0]
+        # tap two lanes: a single lane can legitimately saturate to NEG in
+        # the stripped-down modes, which would false-positive the seed check
+        hr = H[pl.ds(R, 1)][0]
+        out_ref[0, 0, 0] = hr[0, 0] + hr[0, 1]
 
     call = pl.pallas_call(
         kernel,
@@ -193,6 +273,7 @@ def build(mode: int, R: int, B: int, interpret: bool):
         out_shape=jax.ShapeDtypeStruct((B, 1, 1), jnp.int32),
         scratch_shapes=[
             pltpu.VMEM((R + 1, 1, 8 * JW) if mode == 6 else
+                       (R + 1, 2, 8, JW) if mode == 8 else
                        (R + 1, 8, JW), jnp.int32),   # H
             pltpu.VMEM((8, NW), jnp.int32),          # order
             pltpu.VMEM((8, NW), jnp.int32),          # base
@@ -215,13 +296,15 @@ def main():
     # seed-dependence check below
     assert R <= 8 * 256 - 1, f"R={R} exceeds the 2047 node-slot capacity"
 
+    from racon_tpu.tools import force_cpu_if_requested
+    force_cpu_if_requested()
     import jax
 
     platform = jax.devices()[0].platform
     interp = platform != "tpu"
     print(f"platform={platform} R={R} B={B}")
     prev = 0.0
-    for mode in range(7):
+    for mode in range(9):
         fn = build(mode, R, B, interp)
         seed = np.zeros((B, 1, 1), np.int32)
         t0 = time.time()
@@ -238,7 +321,8 @@ def main():
             jax.block_until_ready(fn(seed + i + 1))
             dt = time.time() - t0
             best = dt if best is None else min(best, dt)
-        per_node_us = best / (R * B) * 1e6
+        rows = R * B * (2 if mode == 8 else 1)
+        per_node_us = best / rows * 1e6
         folded = " [FOLDED? output ignores seed — timing is fiction]" \
             if o1 == o2 else ""
         print(f"mode={mode} first={first:.2f}s warm={best:.4f}s "
