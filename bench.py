@@ -11,6 +11,10 @@ second of end-to-end wall time (parse -> polished FASTA) on the accelerated
 path; vs_baseline = speedup over the host CPU path measured on the same
 machine (the reference's comparison axis: accelerated backend vs its CPU
 SPOA path).
+
+RACON_TPU_BENCH_INPUT=sam switches the overlaps to SAM with ground-truth
+CIGARs (the reference's SAM scenarios): no alignment phase, so the number
+isolates the consensus engines. The recorded default stays PAF.
 """
 
 import json
@@ -20,6 +24,7 @@ import sys
 import time
 
 MBP = float(os.environ.get("RACON_TPU_BENCH_MBP", "0.5"))
+INPUT = os.environ.get("RACON_TPU_BENCH_INPUT", "paf")
 COVERAGE = 30
 ARGS = dict(window_length=500, quality_threshold=10.0, error_threshold=0.3,
             match=5, mismatch=-4, gap=-8, num_threads=1)
@@ -46,9 +51,10 @@ def dataset():
             os.rename(tmpdir, outdir)
         except OSError:
             shutil.rmtree(tmpdir, ignore_errors=True)  # another run won
+    ovl = "overlaps.sam" if INPUT == "sam" else "overlaps.paf"
     return {k: os.path.join(outdir, f)
             for k, f in (("reads", "reads.fastq"),
-                         ("overlaps", "overlaps.paf"),
+                         ("overlaps", ovl),
                          ("draft", "draft.fasta"))}
 
 
@@ -153,15 +159,18 @@ def main():
         prev = last_device_measurement()
         note = ""
         if prev:
+            # .get() throughout: the log file is committed and hand-
+            # editable; a malformed entry must not crash the degraded path
             tier = "pallas" if prev.get("pallas") else "XLA-fallback"
-            note = (f"; last healthy device run {prev['utc']} ({tier}): "
-                    f"{prev['value']} Mbp/s, vs_baseline "
-                    f"{prev['vs_baseline']} on {prev['mbp']} Mbp")
+            note = (f"; last healthy device run {prev.get('utc', '?')} "
+                    f"({tier}): {prev.get('value', '?')} Mbp/s, vs_baseline "
+                    f"{prev.get('vs_baseline', '?')} on "
+                    f"{prev.get('mbp', '?')} Mbp")
         bp_cpu, dt_cpu = run("cpu", paths)
         mbps_cpu = bp_cpu / dt_cpu / 1e6
         print(json.dumps({
             "metric": f"polished Mbp/sec (synthetic ONT {MBP} Mbp "
-                      f"{COVERAGE}x, PAF, w=500, end-to-end) "
+                      f"{COVERAGE}x, {INPUT.upper()}, w=500, end-to-end) "
                       f"[TPU UNREACHABLE: host path only{note}]",
             "value": round(mbps_cpu, 4),
             "unit": "Mbp/s",
@@ -187,14 +196,14 @@ def main():
     mbps_cpu = bp_cpu / dt_cpu / 1e6
     kernel_tag = "" if pallas_ok else " [XLA kernel: pallas compile failed]"
     log_device_measurement({
-        "mbp": MBP, "value": round(mbps_tpu, 4),
+        "mbp": MBP, "input": INPUT, "value": round(mbps_tpu, 4),
         "vs_baseline": round(mbps_tpu / mbps_cpu, 3),
         "pallas": pallas_ok,
         "tpu_s": round(dt_tpu, 1), "cpu_s": round(dt_cpu, 1),
     })
     print(json.dumps({
         "metric": f"polished Mbp/sec (synthetic ONT {MBP} Mbp {COVERAGE}x, "
-                  f"PAF, w=500, end-to-end){kernel_tag}",
+                  f"{INPUT.upper()}, w=500, end-to-end){kernel_tag}",
         "value": round(mbps_tpu, 4),
         "unit": "Mbp/s",
         "vs_baseline": round(mbps_tpu / mbps_cpu, 3),

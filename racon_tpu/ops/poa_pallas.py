@@ -131,23 +131,38 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             y = jnp.where(lane == 0, carry, ln)
             return jnp.where(iota2 == 0, fill, y)
 
+        def tree_max(xs):
+            # balanced pairwise reduction: log2 depth independent of any
+            # compiler reassociation of integer max
+            while len(xs) > 1:
+                nxt = [jnp.maximum(a, b) for a, b in zip(xs[::2], xs[1::2])]
+                if len(xs) % 2:
+                    nxt.append(xs[-1])
+                xs = nxt
+            return xs[0]
+
         def cummaxj(x):
-            # prefix max over the blocked j line: lane prefix within each
-            # sublane row, then an exclusive cross-sublane prefix of the
-            # row maxima
-            k = 1
-            while k < JW:
-                x = jnp.maximum(
-                    x, jnp.where(jlane >= k, pltpu.roll(x, k, 1), NEG))
-                k *= 2
+            # prefix max over the blocked j line: radix-4 lane prefix
+            # within each sublane row, then a radix-8 exclusive
+            # cross-sublane prefix of the row maxima. Radix-4/8 does the
+            # same work as the binary scan in about half the
+            # dependency-chain depth (the shifted copies within a round
+            # are independent, and tree_max keeps the combine log-deep) —
+            # this loop is latency-bound, not throughput-bound
+            # (docs/benchmarks.md, dp_cost_probe).
+            w = 1
+            while w < JW:
+                shs = [jnp.where(jlane >= k * w,
+                                 pltpu.roll(x, k * w, 1), NEG)
+                       for k in (1, 2, 3) if k * w < JW]
+                x = tree_max([x] + shs)
+                w *= 4
             tot = jnp.max(x, axis=1, keepdims=True)  # (8, 1) row maxima
             p = jnp.broadcast_to(tot, (8, JW))
-            k = 1
-            while k < 8:
-                p = jnp.maximum(
-                    p, jnp.where(jsub >= k, pltpu.roll(p, k, 0), NEG))
-                k *= 2
-            excl = jnp.where(jsub >= 1, pltpu.roll(p, 1, 0), NEG)
+            # row 0 ends up NEG by construction: every copy is masked by
+            # jsub >= k with k >= 1
+            excl = tree_max([jnp.where(jsub >= k, pltpu.roll(p, k, 0), NEG)
+                             for k in range(1, 8)])
             return jnp.maximum(x, excl)
 
         bb_len = bb_len_ref[0, 0, 0]
@@ -216,6 +231,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             has_out[:] = jnp.zeros((8, NW), jnp.int32)
 
             seqm1 = shift1(seqv, jj, jlane, 255)
+            virt_row = H[0:1][0]        # loop-invariant: hoist out of dp_body
 
             # ---- DP over subgraph nodes in rank order ---------------------
             # Per-cell move records (2 bits move + pred slot, VSLOT =
@@ -243,7 +259,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 P, Pslot, any_valid = jax.lax.fori_loop(
                     0, loadn(in_cnt[:], u), pred_scan,
                     (P0, S0, jnp.bool_(False)))
-                P = jnp.where(any_valid, P, H[0:1][0])
+                P = jnp.where(any_valid, P, virt_row)
                 Pslot = jnp.where(any_valid, Pslot, VSLOT)
 
                 scvec = jnp.where(seqm1 == ub, M, X)

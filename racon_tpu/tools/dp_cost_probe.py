@@ -80,17 +80,25 @@ def build(mode: int, R: int, B: int, interpret: bool):
                 y = jnp.where(jlane == 0, carry, ln)
             return jnp.where(jj == 0, fill, y)
 
+        def tree_max(xs):
+            while len(xs) > 1:
+                nxt = [jnp.maximum(a, b) for a, b in zip(xs[::2], xs[1::2])]
+                if len(xs) % 2:
+                    nxt.append(xs[-1])
+                xs = nxt
+            return xs[0]
+
         def cummaxj(x):
             if mode == 7:
-                # radix-4 lane prefix: 4 rounds of 3 independent shifted
-                # copies (shallower dependency chain than 7 binary rounds)
+                # radix-4 lane prefix: rounds of 3 independent shifted
+                # copies, tree-combined (shallower chain than 7 binary
+                # rounds)
                 w = 1
                 while w < JW:
                     shs = [jnp.where(jlane >= k * w,
                                      pltpu.roll(x, k * w, 1), NEG)
                            for k in (1, 2, 3) if k * w < JW]
-                    for sh in shs:
-                        x = jnp.maximum(x, sh)
+                    x = tree_max([x] + shs)
                     w *= 4
             else:
                 k = 1
@@ -103,14 +111,11 @@ def build(mode: int, R: int, B: int, interpret: bool):
             tot = jnp.max(x, axis=1, keepdims=True)
             p = jnp.broadcast_to(tot, x.shape)
             if mode == 7:
-                # radix-8 sublane prefix: 7 independent shifted copies
-                shs = [jnp.where(jsub >= k, pltpu.roll(p, k, 0), NEG)
-                       for k in range(1, 8)]
-                e = NEG * jnp.ones_like(p)
-                for sh in shs:
-                    e = jnp.maximum(e, sh)
-                excl = jnp.where(jsub >= 1, e, NEG)
-                return jnp.maximum(x, excl)
+                # radix-8 sublane exclusive prefix: 7 independent shifted
+                # copies, tree-combined (row 0 is NEG by the jsub masks)
+                return jnp.maximum(x, tree_max(
+                    [jnp.where(jsub >= k, pltpu.roll(p, k, 0), NEG)
+                     for k in range(1, 8)]))
             k = 1
             while k < 8:
                 p = jnp.maximum(

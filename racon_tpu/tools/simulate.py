@@ -22,9 +22,41 @@ import numpy as np
 BASES = np.frombuffer(b"ACGT", dtype=np.uint8)
 
 
+_OP_CHARS = np.frombuffer(b"MDI", dtype=np.uint8)
+
+
+def _cigar_from_ops(ops: np.ndarray, start: int, end: int):
+    """RLE an op-code array (0=M, 1=D, 2=I) into a CIGAR string, clipping
+    leading/trailing deletion runs (invalid in SAM) by moving the target
+    coordinates inward. Returns (cigar, start, end)."""
+    # clip boundary D runs
+    lo = 0
+    while lo < len(ops) and ops[lo] == 1:
+        lo += 1
+    hi = len(ops)
+    while hi > lo and ops[hi - 1] == 1:
+        hi -= 1
+    start += lo
+    end -= len(ops) - hi
+    ops = ops[lo:hi]
+    if not len(ops):
+        return "", start, end
+    bounds = np.nonzero(np.diff(ops))[0] + 1
+    starts = np.concatenate([[0], bounds])
+    ends = np.concatenate([bounds, [len(ops)]])
+    cigar = "".join(f"{e - s}{chr(_OP_CHARS[ops[s]])}"
+                    for s, e in zip(starts, ends))
+    return cigar, start, end
+
+
 def _mutate_reads(genome: np.ndarray, rng, n_reads: int, mean_len: int,
                   sub: float, ins: float, dele: float):
-    """Yield (start, end, strand, read_bytes) tuples."""
+    """Yield (start, end, strand, read_bytes, fwd_bytes, cigar) tuples.
+
+    fwd_bytes is the read in target orientation (what a SAM record's SEQ
+    column carries for a reverse-strand read), cigar the true alignment of
+    fwd_bytes to the target — both from simulation ground truth.
+    """
     g_len = len(genome)
     comp = np.zeros(256, dtype=np.uint8)
     for a, b in zip(b"ACGT", b"TGCA"):
@@ -55,10 +87,23 @@ def _mutate_reads(genome: np.ndarray, rng, n_reads: int, mean_len: int,
             out[ins_at] = BASES[rng.integers(0, 4, n_ins)]
             seg = out
 
+        # true op stream in target orientation: M/D per genome position,
+        # with each I scattered after its (post-deletion) M
+        ops_orig = np.where(keep, 0, 1).astype(np.uint8)
+        ins_after = np.zeros(length, dtype=np.int64)
+        if n_ins:
+            ins_after[np.nonzero(keep)[0]] = ins_mask.astype(np.int64)
+        shift = np.concatenate([[0], np.cumsum(ins_after)[:-1]])
+        ops = np.full(length + int(ins_after.sum()), 2, dtype=np.uint8)
+        ops[np.arange(length) + shift] = ops_orig
+        cigar, cg_start, cg_end = _cigar_from_ops(ops, start, start + length)
+
         strand = bool(rng.integers(0, 2))
+        fwd = seg
         if strand:
             seg = comp[seg][::-1]
-        yield start, start + length, strand, seg
+        yield start, start + length, strand, seg, fwd, (cigar, cg_start,
+                                                        cg_end)
 
 
 def generate(outdir: str, mbp: float = 1.0, coverage: int = 30,
@@ -79,6 +124,7 @@ def generate(outdir: str, mbp: float = 1.0, coverage: int = 30,
         "draft": os.path.join(outdir, "draft.fasta"),
         "reads": os.path.join(outdir, "reads.fastq"),
         "overlaps": os.path.join(outdir, "overlaps.paf"),
+        "overlaps_sam": os.path.join(outdir, "overlaps.sam"),
     }
 
     with open(paths["genome"], "w") as f:
@@ -92,8 +138,12 @@ def generate(outdir: str, mbp: float = 1.0, coverage: int = 30,
 
     n_reads = max(1, int(g_len * coverage / mean_read))
     qual_char = chr(33 + 15)
-    with open(paths["reads"], "w") as rf, open(paths["overlaps"], "w") as of:
-        for i, (start, end, strand, seg) in enumerate(
+    with open(paths["reads"], "w") as rf, \
+            open(paths["overlaps"], "w") as of, \
+            open(paths["overlaps_sam"], "w") as sf:
+        sf.write("@HD\tVN:1.6\tSO:unsorted\n")
+        sf.write(f"@SQ\tSN:contig\tLN:{g_len}\n")
+        for i, (start, end, strand, seg, fwd, cg) in enumerate(
                 _mutate_reads(genome, rng, n_reads, mean_read, sub, ins,
                               dele)):
             name = f"read{i}"
@@ -103,6 +153,13 @@ def generate(outdir: str, mbp: float = 1.0, coverage: int = 30,
                      f"{'-' if strand else '+'}\tcontig\t{g_len}\t{start}\t"
                      f"{end}\t{min(len(seg), end - start)}\t"
                      f"{max(len(seg), end - start)}\t60\n")
+            # SAM record with the TRUE alignment (what minimap2 -a would
+            # approximate): SEQ in target orientation, ground-truth CIGAR
+            cigar, cg_start, _cg_end = cg
+            flag = 16 if strand else 0
+            sf.write(f"{name}\t{flag}\tcontig\t{cg_start + 1}\t60\t{cigar}"
+                     f"\t*\t0\t0\t{fwd.tobytes().decode()}\t"
+                     f"{qual_char * len(fwd)}\n")
     return paths
 
 
