@@ -87,7 +87,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                cons_base_ref, cons_cov_ref, cons_len_ref, failed_ref,
                n_nodes_ref,
                H, MV, base, key, cov, order, in_src, in_w, in_cnt,
-               pos_node, nkey, runrem, score, pred, revbuf, has_out,
+               pos_node, nkey, runrem, score, pred, revbuf, esc, rank_of,
                seq_scr, w_scr, dma_sem):
         jlane = jax.lax.broadcasted_iota(jnp.int32, (8, JW), 1)
         jsub = jax.lax.broadcasted_iota(jnp.int32, (8, JW), 0)
@@ -122,6 +122,15 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
         def ermw(ref, e, u, val):
             row = ref[pl.ds(e, 1)][0]
             ref[pl.ds(e, 1)] = jnp.where(nn_i == u, val,
+                                         row).reshape(1, 8, NW)
+
+        # masked increments: no scalar read-back needed
+        def rmwn_add(ref, idx, delta):
+            ref[:] = jnp.where(nn_i == idx, ref[:] + delta, ref[:])
+
+        def ermw_add(ref, e, u, delta):
+            row = ref[pl.ds(e, 1)][0]
+            ref[pl.ds(e, 1)] = jnp.where(nn_i == u, row + delta,
                                          row).reshape(1, 8, NW)
 
         def shift1(x, iota2, lane, fill):
@@ -228,10 +237,17 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             r_hi = jnp.minimum(
                 jnp.sum(jnp.where(keys <= hi, 1, 0)).astype(jnp.int32), n)
 
-            has_out[:] = jnp.zeros((8, NW), jnp.int32)
-
             seqm1 = shift1(seqv, jj, jlane, 255)
             virt_row = H[0:1][0]        # loop-invariant: hoist out of dp_body
+
+            # End-node selection is fused into the DP sweep: each node's
+            # score at column Ln lands in esc (indexed by RANK, so "first
+            # max in rank order" is just "lowest index among maxima"), and
+            # gaining an in-subgraph out-edge cancels the source's slot —
+            # predecessors always precede successors in rank order, so the
+            # cancel never races the write. rank_of maps node id -> rank
+            # for the cancel. This removes the separate end_body sweep.
+            esc[:] = jnp.full((8, NW), NEG, jnp.int32)
 
             # ---- DP over subgraph nodes in rank order ---------------------
             # Per-cell move records (2 bits move + pred slot, VSLOT =
@@ -239,6 +255,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             def dp_body(r, _):
                 u = loadn(order[:], r)
                 ub = loadn(base[:], u)
+                rmwn(rank_of, u, r)
 
                 def pred_scan(e, c):
                     P, Pslot, any_valid = c
@@ -251,7 +268,10 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
                     @pl.when(ok)
                     def _():
-                        rmwn(has_out, jnp.maximum(src, 0), 1)
+                        # src has an out-edge inside the subgraph: not an
+                        # end node
+                        rmwn(esc, loadn(rank_of[:], jnp.maximum(src, 0)),
+                             NEG)
                     return (P, Pslot, any_valid | ok)
 
                 P0 = jnp.full((8, JW), NEG, jnp.int32)
@@ -274,23 +294,19 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 mv = jnp.where(row > V, 2, vmove)  # left only if strictly better
                 H[pl.ds(u + 1, 1)] = row.reshape(1, 8, JW)
                 MV[pl.ds(u + 1, 1)] = mv.reshape(1, 8, JW)
+                rmwn(esc, r, loadj(row, Ln))
                 return 0
 
             jax.lax.fori_loop(r_lo, r_hi, dp_body, 0)
 
             # ---- best end node (first max in rank order) ------------------
-            def end_body(r, c):
-                best_u, best_s = c
-                u = loadn(order[:], r)
-                is_end = loadn(has_out[:], u) == 0
-                s = loadj(H[pl.ds(u + 1, 1)][0], Ln)
-                better = is_end & (s > best_s)
-                return (jnp.where(better, u, best_u),
-                        jnp.where(better, s, best_s))
-
-            best_u, _ = jax.lax.fori_loop(
-                r_lo, r_hi, end_body,
-                (jnp.int32(-1), jnp.int32(NEG)))
+            escv = esc[:]
+            in_range = (nn_i >= r_lo) & (nn_i < r_hi)
+            best_s = jnp.max(jnp.where(in_range, escv, NEG))
+            best_r = jnp.min(jnp.where(in_range & (escv == best_s), nn_i,
+                                       SN)).astype(jnp.int32)
+            best_u = jnp.where(best_s > NEG, loadn(order[:], best_r),
+                               jnp.int32(-1))
 
             # ---- traceback -------------------------------------------------
             pos_node[:] = jnp.full((8, JW), -1, jnp.int32)
@@ -349,14 +365,16 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 wj = loadj(wv, j)
                 pn = loadj(pos_node[:], j)
                 is_match = pn >= 0
-                k0 = loadn(key[:], jnp.maximum(pn, 0))
+                nk = loadj(nkey[:], j)
+                # at a matched position, nkey[j] IS key[pos_node[j]] (the
+                # backward pass wrote it) — saves the key[] reduction
+                k0 = nk
 
                 keys = key[:]
                 cand = (keys == k0) & (base[:] == b)
                 has = cand.any() & is_match
                 found = jnp.min(jnp.where(cand, nn_i, SN)).astype(jnp.int32)
 
-                nk = loadj(nkey[:], j)
                 run = loadj(runrem[:], j).astype(jnp.float32)
                 hi2 = jnp.where(nk < KEY_INF, nk, prev_key + 1.0)
                 lo2 = jnp.where(prev >= 0, prev_key, hi2 - run - 1.0)
@@ -385,7 +403,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
                 @pl.when(touch)
                 def _():
-                    rmwn(cov, nid, loadn(cov[:], nid) + 1)
+                    rmwn_add(cov, nid, 1)
 
                 n = n + jnp.where(do_new, 1, 0)
                 failed = failed | overflow
@@ -407,8 +425,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
                 @pl.when(has_prev & (same_slot >= 0))
                 def _():
-                    ermw(in_w, same_slot, nid,
-                         eload(in_w, same_slot, nid) + ew)
+                    ermw_add(in_w, jnp.maximum(same_slot, 0), nid, ew)
 
                 @pl.when(has_prev & (same_slot < 0) & (empty_slot >= 0))
                 def _():
@@ -418,7 +435,10 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
                 failed = failed | (has_prev & (same_slot < 0) &
                                    (empty_slot < 0))
-                return (n, failed, nid, loadn(key[:], nid), wj)
+                # key[nid] == key_val in every non-overflow case (matched:
+                # key_val = k0 = key[found]; new: just written), and under
+                # overflow the window is already failed — saves a reduction
+                return (n, failed, nid, key_val, wj)
 
             n, failed, _, _, _ = jax.lax.fori_loop(
                 0, Ln, upd_body,
@@ -570,7 +590,8 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 pltpu.VMEM((8, NW), jnp.int32),         # score
                 pltpu.VMEM((8, NW), jnp.int32),         # pred
                 pltpu.VMEM((8, NW), jnp.int32),         # revbuf
-                pltpu.VMEM((8, NW), jnp.int32),         # has_out
+                pltpu.VMEM((8, NW), jnp.int32),         # esc (end scores)
+                pltpu.VMEM((8, NW), jnp.int32),         # rank_of
                 pltpu.VMEM((2, 8, JW), jnp.int32),      # seq_scr (2 slots)
                 pltpu.VMEM((2, 8, JW), jnp.int32),      # w_scr
                 pltpu.SemaphoreType.DMA((2, 2)),        # per (slot, array)
