@@ -87,7 +87,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                cons_base_ref, cons_cov_ref, cons_len_ref, failed_ref,
                n_nodes_ref,
                H, MV, base, key, cov, order, in_src, in_w, in_cnt,
-               pos_node, nkey, runrem, score, pred, revbuf, esc, rank_of,
+               nkey, runrem, score, pred, revbuf, esc, rank_of,
                seq_scr, w_scr, dma_sem):
         jlane = jax.lax.broadcasted_iota(jnp.int32, (8, JW), 1)
         jsub = jax.lax.broadcasted_iota(jnp.int32, (8, JW), 0)
@@ -309,14 +309,19 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                                jnp.int32(-1))
 
             # ---- traceback -------------------------------------------------
-            pos_node[:] = jnp.full((8, JW), -1, jnp.int32)
+            # The walk visits j strictly downward, so the backward
+            # next-matched-key / run-remaining pass rides along for free:
+            # every j-decrement is either a match (diag: record key[u],
+            # reset the run) or an insertion (left: extend the run), and
+            # nkey/runrem are exactly what the graph update needs — the
+            # old pos_node array and its separate backward sweep are gone.
 
             def tb_cond(c):
-                u, j, steps, ok = c
+                u, j, steps, nk, run = c
                 return (~((u == -1) & (j == 0))) & (steps < N + L + 2)
 
             def tb_body(c):
-                u, j, steps, ok = c
+                u, j, steps, nk, run = c
                 at_virtual = u == -1
                 uc = jnp.maximum(u, 0)
                 jm1 = jnp.maximum(j - 1, 0)
@@ -329,45 +334,36 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
                 take_diag = ~at_virtual & (move == 0)
                 take_up = ~at_virtual & (move == 1)
+                descend = ~take_up                # j-1 gets its record now
+                nk = jnp.where(take_diag, loadn(key[:], uc), nk)
+                run = jnp.where(take_diag, 0,
+                                jnp.where(descend, run + 1, run))
 
-                @pl.when(take_diag)
+                @pl.when(descend)
                 def _():
-                    rmwj(pos_node, jm1, u)
+                    rmwj(nkey, jm1, nk)
+                    rmwj(runrem, jm1, run)
 
                 new_u = jnp.where(take_diag | take_up, prd, u)
                 new_j = jnp.where(take_up, j, j - 1)
-                return (new_u, new_j, steps + 1, ok)
+                return (new_u, new_j, steps + 1, nk, run)
 
-            fu, fj, _, _ = jax.lax.while_loop(
+            fu, fj, _, _, _ = jax.lax.while_loop(
                 tb_cond, tb_body,
-                (best_u, Ln, jnp.int32(0), jnp.bool_(True)))
+                (best_u, Ln, jnp.int32(0), jnp.float32(KEY_INF),
+                 jnp.int32(0)))
             failed = failed | ~((fu == -1) & (fj == 0))
-
-            # ---- next-matched-key / run-remaining (backward) ---------------
-            def back_body(i, c):
-                nk, run = c
-                j = Ln - 1 - i
-                pn = loadj(pos_node[:], j)
-                m = pn >= 0
-                nk = jnp.where(m, loadn(key[:], jnp.maximum(pn, 0)), nk)
-                run = jnp.where(m, 0, run + 1)
-                rmwj(nkey, j, nk)
-                rmwj(runrem, j, run)
-                return (nk, run)
-
-            jax.lax.fori_loop(0, Ln, back_body,
-                              (jnp.float32(KEY_INF), jnp.int32(0)))
 
             # ---- graph update ----------------------------------------------
             def upd_body(j, c):
                 n, failed, prev, prev_key, prev_w = c
                 b = loadj(seqv, j)
                 wj = loadj(wv, j)
-                pn = loadj(pos_node[:], j)
-                is_match = pn >= 0
+                run_j = loadj(runrem[:], j)
+                is_match = run_j == 0       # a zero run marks a match
                 nk = loadj(nkey[:], j)
-                # at a matched position, nkey[j] IS key[pos_node[j]] (the
-                # backward pass wrote it) — saves the key[] reduction
+                # at a matched position, nkey[j] IS the matched node's
+                # column key (the traceback wrote it) — no key[] reduction
                 k0 = nk
 
                 keys = key[:]
@@ -375,7 +371,7 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 has = cand.any() & is_match
                 found = jnp.min(jnp.where(cand, nn_i, SN)).astype(jnp.int32)
 
-                run = loadj(runrem[:], j).astype(jnp.float32)
+                run = run_j.astype(jnp.float32)
                 hi2 = jnp.where(nk < KEY_INF, nk, prev_key + 1.0)
                 lo2 = jnp.where(prev >= 0, prev_key, hi2 - run - 1.0)
                 k_new = lo2 + (hi2 - lo2) / (run + 1.0)
@@ -584,7 +580,6 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 pltpu.VMEM((E, 8, NW), jnp.int32),      # in_src
                 pltpu.VMEM((E, 8, NW), jnp.int32),      # in_w
                 pltpu.VMEM((8, NW), jnp.int32),         # in_cnt
-                pltpu.VMEM((8, JW), jnp.int32),         # pos_node
                 pltpu.VMEM((8, JW), jnp.float32),       # nkey
                 pltpu.VMEM((8, JW), jnp.int32),         # runrem
                 pltpu.VMEM((8, NW), jnp.int32),         # score
