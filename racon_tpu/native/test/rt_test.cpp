@@ -1,0 +1,204 @@
+// Native unit tests for the host runtime (the C++ twin of the pytest
+// layer — the reference keeps its unit tests native in test/racon_test.cpp;
+// the end-to-end goldens live in tests/test_golden.py which exercises this
+// same code through the C ABI).
+//
+// Plain CHECK macros instead of a vendored gtest: the framework must build
+// with zero network access, and the assertions here are simple equality
+// checks. Build + run:  make -C racon_tpu/native test
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "../src/rt_align.hpp"
+#include "../src/rt_overlap.hpp"
+#include "../src/rt_poa.hpp"
+#include "../src/rt_sequence.hpp"
+
+static int g_failures = 0;
+static int g_checks = 0;
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    ++g_checks;                                                           \
+    if (!(cond)) {                                                        \
+      ++g_failures;                                                       \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+    }                                                                     \
+  } while (0)
+
+#define CHECK_EQ(a, b)                                                    \
+  do {                                                                    \
+    ++g_checks;                                                           \
+    auto va = (a);                                                        \
+    auto vb = (b);                                                        \
+    if (!(va == vb)) {                                                    \
+      ++g_failures;                                                       \
+      std::fprintf(stderr, "FAIL %s:%d: %s != %s\n", __FILE__, __LINE__,  \
+                   #a, #b);                                               \
+    }                                                                     \
+  } while (0)
+
+// ---- Sequence -------------------------------------------------------------
+
+static void test_sequence() {
+  // uppercasing (reference: src/sequence.cpp:24-27)
+  rt::Sequence s("r", 1, "acgtn", 5);
+  CHECK_EQ(s.data, std::string("ACGTN"));
+
+  // informative quality is kept
+  rt::Sequence q("r", 1, "ACGT", 4, "!!5!", 4);
+  CHECK_EQ(q.quality, std::string("!!5!"));
+
+  // all-'!' quality carries no information and is dropped
+  // (reference: src/sequence.cpp:34-42)
+  rt::Sequence z("r", 1, "ACGT", 4, "!!!!", 4);
+  CHECK(z.quality.empty());
+
+  // reverse complement + reversed quality, idempotent
+  // (reference: src/sequence.cpp:49-84)
+  q.create_reverse_complement();
+  CHECK_EQ(q.reverse_complement, std::string("ACGT"));
+  rt::Sequence r("r", 1, "AACG", 4, "!05!", 4);
+  r.create_reverse_complement();
+  CHECK_EQ(r.reverse_complement, std::string("CGTT"));
+  CHECK_EQ(r.reverse_quality, std::string("!50!"));
+  r.create_reverse_complement();
+  CHECK_EQ(r.reverse_complement, std::string("CGTT"));
+}
+
+// ---- alignment kernels -----------------------------------------------------
+
+static void test_align() {
+  // pinned small distances
+  CHECK_EQ(rt::edit_distance("kitten", 6, "sitting", 7), 3);
+  CHECK_EQ(rt::edit_distance("", 0, "abc", 3), 3);
+  CHECK_EQ(rt::edit_distance("ACGT", 4, "ACGT", 4), 0);
+  // symmetry
+  CHECK_EQ(rt::edit_distance("ACGTACGT", 8, "AGTACGGT", 8),
+           rt::edit_distance("AGTACGGT", 8, "ACGTACGT", 8));
+
+  // the CIGAR's edit count must equal the exact distance, and its spans
+  // must cover both sequences
+  const std::string qs = "ACGTTTACGGTACGT";
+  const std::string ts = "ACGTACGGTACGTTT";
+  std::string cig = rt::align_global_cigar(qs.data(), qs.size(), ts.data(),
+                                           ts.size());
+  int64_t q_span = 0, t_span = 0, edits = 0;
+  uint32_t run = 0;
+  for (char c : cig) {
+    if (c >= '0' && c <= '9') {
+      run = run * 10 + (c - '0');
+      continue;
+    }
+    if (c == 'M' || c == '=') {
+      q_span += run;
+      t_span += run;
+    } else if (c == 'X') {
+      q_span += run;
+      t_span += run;
+      edits += run;
+    } else if (c == 'I') {
+      q_span += run;
+      edits += run;
+    } else if (c == 'D') {
+      t_span += run;
+      edits += run;
+    }
+    run = 0;
+  }
+  CHECK_EQ(q_span, (int64_t)qs.size());
+  CHECK_EQ(t_span, (int64_t)ts.size());
+  CHECK_EQ(edits, rt::edit_distance(qs.data(), qs.size(), ts.data(),
+                                    ts.size()));
+}
+
+// ---- Overlap ---------------------------------------------------------------
+
+static void test_overlap() {
+  // PAF ctor + span-ratio error metric (reference: src/overlap.cpp:24-42)
+  auto paf = rt::Overlap::from_paf("q", 100, 0, 80, '+', "t", 200, 10, 110);
+  CHECK_EQ(paf->length, 100u);
+  CHECK(paf->error > 0.19 && paf->error < 0.21);  // 1 - 80/100
+  CHECK(!paf->strand);
+
+  // MHAP ctor: 1-based ordinals, rc flags (reference: src/overlap.cpp:15-27)
+  auto mhap = rt::Overlap::from_mhap(1, 2, 0.1, 10, 0, 0, 80, 100, 1, 10,
+                                     110, 200);
+  CHECK(mhap->strand);
+
+  // SAM ctor scans the CIGAR for spans (reference: src/overlap.cpp:44-108)
+  auto sam = rt::Overlap::from_sam("q", 0, "t", 11, "20M5I20M5D20M");
+  CHECK_EQ(sam->q_begin, 0u);
+  CHECK_EQ(sam->q_end, 65u);        // 20+5+20+20 query bases
+  CHECK_EQ(sam->t_begin, 10u);      // pos is 1-based
+  CHECK_EQ(sam->t_end, 10u + 65u);  // 20+20+5+20 target bases
+
+  // transmute resolves names and validates lengths
+  // (reference: src/overlap.cpp:129-177)
+  std::vector<std::unique_ptr<rt::Sequence>> seqs;
+  seqs.push_back(rt::createSequence("q", std::string(100, 'A')));
+  seqs.push_back(rt::createSequence("t", std::string(200, 'C')));
+  // keys carry a q/t suffix, the reference's disambiguation scheme for a
+  // name that is both a read and a target (src/polisher.cpp:210-215)
+  std::unordered_map<std::string, uint64_t> name_to_id{{"qq", 0}, {"tt", 1}};
+  std::unordered_map<uint64_t, uint64_t> id_to_id;
+  paf->transmute(seqs, name_to_id, id_to_id);
+  CHECK(paf->is_transmuted);
+  CHECK_EQ(paf->q_id, 0u);
+  CHECK_EQ(paf->t_id, 1u);
+
+  // breaking points from a pure-match CIGAR land on window boundaries
+  // (reference: src/overlap.cpp:226-292)
+  auto bp = rt::Overlap::from_sam("q", 0, "t", 1, "100M");
+  bp->transmute(seqs, name_to_id, id_to_id);
+  bp->find_breaking_points(seqs, 50);
+  CHECK_EQ(bp->breaking_points.size(), 4u);  // two windows x (first, last)
+  CHECK_EQ(bp->breaking_points[0].first, 0u);
+  CHECK_EQ(bp->breaking_points[1].first, 50u);
+  CHECK_EQ(bp->breaking_points[2].first, 50u);
+  CHECK_EQ(bp->breaking_points[3].first, 100u);
+}
+
+// ---- POA graph -------------------------------------------------------------
+
+static void test_poa() {
+  // three identical layers over a backbone with one error: the consensus
+  // recovers the majority base, coverage counts the paths through the
+  // chosen nodes
+  const std::string backbone = "ACGTACGT";
+  const std::string truth = "ACGAACGT";  // backbone has T where truth has A
+  rt::PoaGraph g;
+  std::vector<uint32_t> w1(backbone.size(), 1);
+  g.add_alignment({}, backbone.data(), backbone.size(), w1);
+  rt::PoaAligner aligner(5, -4, -8);
+  const double inf = 1e300;
+  for (int i = 0; i < 3; ++i) {
+    auto aln = aligner.align(truth.data(), truth.size(), g, -inf, inf);
+    std::vector<uint32_t> w(truth.size(), 1);
+    g.add_alignment(aln, truth.data(), truth.size(), w);
+  }
+  std::vector<uint32_t> cov;
+  std::string cons = g.generate_consensus(&cov);
+  CHECK_EQ(cons, truth);
+  CHECK_EQ(cov.size(), cons.size());
+  CHECK_EQ(cov[3], 3u);  // the corrected base: 3 supporting layers
+  CHECK_EQ(cov[0], 4u);  // agreeing base: backbone + 3 layers
+}
+
+int main() {
+  test_sequence();
+  test_align();
+  test_overlap();
+  test_poa();
+  if (g_failures) {
+    std::fprintf(stderr, "%d/%d checks FAILED\n", g_failures, g_checks);
+    return 1;
+  }
+  std::printf("all %d checks passed\n", g_checks);
+  return 0;
+}

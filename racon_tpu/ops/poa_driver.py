@@ -120,6 +120,12 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
             # kernel's VMEM budget; the flag must flip HERE so _submit and
             # _unpack agree with the kernel _build_kernel actually returns.
             bucket_pallas = use_pallas and _fits_vmem(cfg)
+            # (Per-bucket depth is kept deliberately: the fused kernel's
+            # VMEM footprint is depth-independent now, but packing and
+            # host->device transfer scale with the padded depth — a single
+            # DEPTH_CAP geometry would ship ~25x zeros for the shallow
+            # buckets on every chunk to save compiles that the lru +
+            # persistent compilation caches already amortize.)
             kernel = _build_kernel(cfg, B, bucket_pallas)
             # Sequential loops run lock-step across the batch, so keep
             # batches depth-homogeneous.
