@@ -10,5 +10,26 @@ Pallas kernels sharded over TPU meshes (racon_tpu/ops, racon_tpu/parallel).
 
 __version__ = "0.1.0"
 
+import os as _os
+import sys as _sys
+
+# Persistent XLA/Mosaic compilation cache: the fused POA kernel takes tens
+# of seconds to compile per geometry, and the axon TPU tunnel wedges for
+# hours at a time — a cache that survives process restarts (and tunnel
+# flaps) means each geometry is compiled once per machine, not once per
+# run. Harmless on CPU. If jax was imported before us its config already
+# captured the env, so set it through the config API instead.
+if not _os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    # uid-suffixed: a world-shared fixed path breaks for the second user
+    # on a machine (PermissionError -> jax silently skips the cache)
+    _cache = f"/tmp/racon_tpu_jax_cache_{_os.getuid()}"
+    _os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache
+    _os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    if "jax" in _sys.modules:
+        _sys.modules["jax"].config.update("jax_compilation_cache_dir",
+                                          _cache)
+        _sys.modules["jax"].config.update(
+            "jax_persistent_cache_min_compile_time_secs", 1)
+
 from .polisher import CpuPolisher, TpuPolisher, create_polisher  # noqa: F401
 from .pipeline import Pipeline  # noqa: F401

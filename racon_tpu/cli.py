@@ -62,20 +62,30 @@ def build_arg_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
 
-    polisher = create_polisher(
-        args.sequences, args.overlaps, args.targets,
-        backend="tpu" if args.tpu else "cpu",
-        fragment_correction=args.fragment_correction,
-        window_length=args.window_length,
-        quality_threshold=args.quality_threshold,
-        error_threshold=args.error_threshold,
-        trim=not args.no_trimming,
-        match=args.match, mismatch=args.mismatch, gap=args.gap,
-        num_threads=args.threads)
+    from .native import NativeError
 
-    polisher.initialize()
-    for name, data in polisher.polish(not args.include_unpolished):
-        sys.stdout.write(f">{name}\n{data}\n")
+    try:
+        polisher = create_polisher(
+            args.sequences, args.overlaps, args.targets,
+            backend="tpu" if args.tpu else "cpu",
+            fragment_correction=args.fragment_correction,
+            window_length=args.window_length,
+            quality_threshold=args.quality_threshold,
+            error_threshold=args.error_threshold,
+            trim=not args.no_trimming,
+            match=args.match, mismatch=args.mismatch, gap=args.gap,
+            num_threads=args.threads)
+        polisher.initialize()
+        for name, data in polisher.polish(not args.include_unpolished):
+            sys.stdout.write(f">{name}\n{data}\n")
+    except NativeError as e:
+        # the reference binary surfaces runtime errors as the what() text
+        # and a non-zero exit (src/main.cpp catches nothing); a Python
+        # traceback is not that interface — and errors fire well past
+        # construction (empty target set, duplicate sequences, ... in
+        # rt_pipeline.cpp initialize/stitch)
+        print(e, file=sys.stderr)
+        return 1
     return 0
 
 

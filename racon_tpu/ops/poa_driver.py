@@ -137,7 +137,10 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
                     bucket_pallas = False
                     kernel = _build_kernel(cfg, B, False)
                 idxs = [i for i, _ in bucket_jobs[off:off + B]]
-                pad = B if (bucket_pallas or n_dev > 1) else None
+                # Always pad to B: a dataset-size-dependent final-chunk
+                # shape would force an extra jit compile per distinct
+                # remainder (padded windows are 1-base/0-layer — free).
+                pad = B
                 chunk = _export_chunk(pipeline, idxs, cfg, fallback)
                 if not chunk:
                     continue
@@ -165,6 +168,38 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
         stats["host_fallback"] += 1
 
     return stats
+
+
+def warm_geometries(window_length: int, match: int, mismatch: int,
+                    gap: int) -> None:
+    """Compile (or load from the persistent cache) every kernel geometry
+    the consensus phase can pick for this window length.
+
+    One all-padding batch per depth bucket (1-base backbones, zero layers)
+    runs in milliseconds but forces the full compile — so a benchmark's
+    measured pass never pays compile time, whatever depth mix the real
+    dataset produces."""
+    from ..parallel.mesh import divisible_batch
+
+    n_dev = _n_devices()
+    B = divisible_batch(n_dev, _batch_size())
+    use_pallas = _use_pallas()
+    for depth_bucket in DEPTH_BUCKETS:
+        cfg = make_config(max(window_length, 1), depth_bucket, match,
+                          mismatch, gap)
+        bucket_pallas = use_pallas and _fits_vmem(cfg)
+        kernel = _build_kernel(cfg, B, bucket_pallas)
+        packed = _pack([], cfg, B)
+        try:
+            _unpack(_submit(kernel, packed, bucket_pallas), bucket_pallas)
+        except Exception as e:  # noqa: BLE001
+            # same degrade philosophy as run_consensus_phase: a Mosaic
+            # failure on one geometry must not abort the caller — warm the
+            # XLA tier it will actually fall back to
+            if not bucket_pallas:
+                raise
+            _, kernel = _degrade(e, cfg, B)
+            _unpack(_submit(kernel, packed, False), False)
 
 
 def _degrade(e, cfg, B):

@@ -41,7 +41,7 @@ def make_batch(cfg, B, rng, err=0.11):
         for li in range(cfg.depth):
             layer = mutate(truth, err, rng)[:cfg.max_len]
             seqs[b, li, :len(layer)] = layer
-            ws[b, li, :len(layer)] = rng.integers(1, 30)
+            ws[b, li, :len(layer)] = rng.integers(1, 30, len(layer))
             lens[b, li] = len(layer)
             begins[b, li] = 0
             ends[b, li] = len(draft) - 1

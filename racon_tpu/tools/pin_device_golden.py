@@ -6,7 +6,7 @@ The reference pins its accelerator goldens next to the CPU ones
 (/root/reference/test/racon_test.cpp:316-318, GPU 1385 vs CPU 1312); this
 script produces the number we pin the same way in tests/test_golden.py.
 
-Usage:  python tools/pin_device_golden.py [scenario]
+Usage:  python racon_tpu/tools/pin_device_golden.py [scenario]
 Scenarios: paf (default) | sam | unit
 """
 
