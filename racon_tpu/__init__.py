@@ -19,17 +19,26 @@ import sys as _sys
 # flaps) means each geometry is compiled once per machine, not once per
 # run. Harmless on CPU. If jax was imported before us its config already
 # captured the env, so set it through the config API instead.
-if not _os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-    # uid-suffixed: a world-shared fixed path breaks for the second user
-    # on a machine (PermissionError -> jax silently skips the cache)
+if "JAX_COMPILATION_CACHE_DIR" not in _os.environ:  # "" = explicit opt-out
+    # uid-suffixed: a world-shared fixed path breaks for the second user on
+    # a machine (PermissionError -> jax silently skips the cache). Created
+    # 0700 and ownership-checked so a pre-created dir by another user can
+    # neither disable nor poison the cache.
     _cache = f"/tmp/racon_tpu_jax_cache_{_os.getuid()}"
-    _os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache
-    _os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
-    if "jax" in _sys.modules:
-        _sys.modules["jax"].config.update("jax_compilation_cache_dir",
-                                          _cache)
-        _sys.modules["jax"].config.update(
-            "jax_persistent_cache_min_compile_time_secs", 1)
+    try:
+        _os.makedirs(_cache, mode=0o700, exist_ok=True)
+        _ok = _os.stat(_cache).st_uid == _os.getuid()
+    except OSError:
+        _ok = False
+    if _ok:
+        _os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache
+        _os.environ.setdefault(
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+        if "jax" in _sys.modules:
+            _sys.modules["jax"].config.update(
+                "jax_compilation_cache_dir", _cache)
+            _sys.modules["jax"].config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1)
 
 from .polisher import CpuPolisher, TpuPolisher, create_polisher  # noqa: F401
 from .pipeline import Pipeline  # noqa: F401

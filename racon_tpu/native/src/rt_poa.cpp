@@ -1,8 +1,10 @@
 #include "rt_poa.hpp"
 
 #include <algorithm>
+#include <atomic>
 #include <cassert>
 #include <cmath>
+#include <cstdio>
 #include <limits>
 #include <cstdint>
 #include <cstdlib>
@@ -16,6 +18,51 @@ namespace rt {
 
 namespace {
 constexpr int32_t kNegInf = std::numeric_limits<int32_t>::min() / 4;
+
+// Env-gated (RT_POA_STATS=1) predecessor rank-distance telemetry. The
+// device kernel keeps DP rows in a rank-keyed ring buffer, so a node whose
+// predecessor lies more than RING_ROWS ranks back cannot run on the
+// accelerator; this histogram, dumped at process exit, is how the ring
+// size is chosen (and re-validated) against real workloads.
+struct PredDistStats {
+  std::atomic<uint64_t> edge_hist[16];   // per-edge log2 distance buckets
+  std::atomic<uint64_t> align_hist[16];  // per-align(=layer) max distance
+  std::atomic<uint64_t> edges{0}, aligns{0};
+  std::atomic<int64_t> max_dist{0};
+  std::atomic<int64_t> max_sub{0};  // largest subgraph (DP row count)
+  const bool enabled = []() {
+    const char* v = std::getenv("RT_POA_STATS");
+    return v != nullptr && v[0] == '1';  // RT_POA_STATS=0 means off
+  }();
+
+  static int bucket(int64_t d) {
+    int b = 0;
+    while ((int64_t{1} << b) < d && b < 15) ++b;  // bucket b: d <= 2^b
+    return b;
+  }
+
+  void record(int64_t d, std::atomic<uint64_t>* hist) {
+    hist[bucket(d)].fetch_add(1, std::memory_order_relaxed);
+  }
+
+  ~PredDistStats() {
+    if (!enabled || aligns.load() == 0) return;
+    std::fprintf(stderr, "[rt_poa::stats] pred rank distance: edges=%llu "
+                 "aligns=%llu max=%lld max_sub=%lld\n",
+                 (unsigned long long)edges.load(),
+                 (unsigned long long)aligns.load(),
+                 (long long)max_dist.load(),
+                 (long long)max_sub.load());
+    for (int b = 0; b < 16; ++b) {
+      const uint64_t e = edge_hist[b].load(), a = align_hist[b].load();
+      if (e == 0 && a == 0) continue;
+      std::fprintf(stderr, "[rt_poa::stats]   d<=%-6lld edges=%-10llu "
+                   "align_max=%llu\n", (long long)(int64_t{1} << b),
+                   (unsigned long long)e, (unsigned long long)a);
+    }
+  }
+};
+PredDistStats g_pred_stats;
 }  // namespace
 
 int32_t PoaGraph::new_column(double key) {
@@ -567,6 +614,28 @@ PoaAlignment PoaAligner::align(const char* seq, uint32_t len,
       if (pr > 0) {
         preds_dat_[w++] = pr;
       }
+    }
+  }
+
+  if (g_pred_stats.enabled) {
+    int64_t amax = 0;
+    for (uint32_t r = 0; r < S; ++r) {
+      for (int32_t pi = preds_off_[r]; pi < preds_off_[r + 1]; ++pi) {
+        const int64_t d = static_cast<int64_t>(r) + 1 - preds_dat_[pi];
+        g_pred_stats.record(d, g_pred_stats.edge_hist);
+        amax = std::max(amax, d);
+      }
+    }
+    g_pred_stats.edges.fetch_add(preds_off_[S], std::memory_order_relaxed);
+    g_pred_stats.aligns.fetch_add(1, std::memory_order_relaxed);
+    g_pred_stats.record(amax, g_pred_stats.align_hist);
+    int64_t cur = g_pred_stats.max_dist.load(std::memory_order_relaxed);
+    while (amax > cur &&
+           !g_pred_stats.max_dist.compare_exchange_weak(cur, amax)) {
+    }
+    int64_t cs = g_pred_stats.max_sub.load(std::memory_order_relaxed);
+    while (S > cs &&
+           !g_pred_stats.max_sub.compare_exchange_weak(cs, int64_t{S})) {
     }
   }
 
