@@ -1,0 +1,844 @@
+"""Lane-lockstep fused Pallas POA kernel (v3).
+
+Same window-consensus semantics as the host oracle (rt_poa.cpp) and the v2
+kernel (poa_pallas.py), re-laid for VPU throughput. The v2 kernel runs ONE
+window per grid step; its DP inner loop is a serial dependency chain of
+~50 single-vreg ops at ~40 cycles/op of latency (measured: dp_cost_probe,
+docs/benchmarks.md), so the VPU idles most of the time. This kernel runs
+EIGHT windows per grid step in lock-step, one per sublane:
+
+  * j-rows: (JC, 8, 128) — window g in sublane g, DP column j at
+    [j // 128, g, j % 128]. Every row op serves all 8 windows at once,
+    and lane-only prefix scans replace the v2 layout's cross-sublane
+    carries.
+  * The graph lives in RANK SPACE: arrays (NC, 8, 128) keyed by
+    topological rank (= column-key order), with in-edges stored as rank
+    DISTANCES (rk_delta). Node insertion is a lane shift; there are no
+    node ids at all. Rank distance is bounded in practice: measured max
+    34 on the lambda dataset and 16 on the synthetic ONT bench over ~12M
+    edges (RT_POA_STATS histograms), so distances are capped at DMAX=64
+    and a window with a longer in-subgraph edge fails to the host path
+    (the same degradation lattice as every other device limit).
+  * H rows live in a 128-row rank-keyed VMEM ring (the distance cap makes
+    older rows dead); completed 64-row chunks are DMA'd to an HBM spill
+    buffer under the compute.
+  * No move matrix. The traceback re-derives moves from H values exactly
+    like the pure-JAX twin (poa.py _traceback, differentially verified
+    against the host), walking rank blocks top-down with the spill buffer
+    streamed back through the same ring; insertion runs are applied as
+    one masked vector op per run instead of one step per base.
+
+Reference parity: the per-window program mirrors rt_poa.cpp /
+src/window.cpp (see poa.py's docstring for the layer-by-layer map); the
+batch orchestration mirrors the reference's cudapoa batch
+(/root/reference/src/cuda/cudabatch.cpp).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .poa import PoaConfig
+
+NEG = -(1 << 28)
+G = 8            # windows per kernel program (the sublane dimension)
+RING = 128       # H ring rows (must be 2 * BLK)
+BLK = 64         # HBM spill chunk = traceback block
+DMAX = 64        # max predecessor rank distance the device accepts
+KEY_INF = 3.0e38
+BIG = 1 << 20    # "no slot" sentinel inside packed slot*256+delta minima
+WNONE = BIG * 512
+
+
+def _round_up(x, m):
+    return (x + m - 1) // m * m
+
+
+@functools.lru_cache(maxsize=32)
+def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False):
+    N = cfg.max_nodes
+    L = cfg.max_len
+    BB = cfg.max_backbone
+    E = cfg.max_edges
+    D = cfg.depth
+    assert N % 128 == 0 and BB <= N
+    NC = N // 128                       # node/rank lane-chunks
+    JL = _round_up(L + 1, 128)
+    JC = JL // 128                      # j lane-chunks
+    M = int(cfg.match)
+    X = int(cfg.mismatch)
+    GP = int(cfg.gap)
+
+    def kernel(bb_len_s, n_layers_s, lens_s, begins_s, ends_s,
+               bb_ref, bbw_ref, seqs_hbm, ws_hbm,
+               cons_base_ref, cons_cov_ref, cl_s, fl_s, nn_s, hbm_H,
+               Hring, H0, rk_base, rk_key, rk_cov, rk_cnt, rk_delta, rk_ew,
+               rk_dmax, esc, score, spred, revbuf, nkey, runrem,
+               seq_scr, w_scr, dma_sem, flush_sem, tb_sem):
+        b_prog = pl.program_id(0)
+
+        lane_n = jax.lax.broadcasted_iota(jnp.int32, (NC, G, 128), 2)
+        chunk_n = jax.lax.broadcasted_iota(jnp.int32, (NC, G, 128), 0)
+        rr = chunk_n * 128 + lane_n                    # global rank index
+        lane_j = jax.lax.broadcasted_iota(jnp.int32, (JC, G, 128), 2)
+        chunk_j = jax.lax.broadcasted_iota(jnp.int32, (JC, G, 128), 0)
+        jj = chunk_j * 128 + lane_j                    # global j index
+        lane1 = jax.lax.broadcasted_iota(jnp.int32, (G, 128), 1)
+        giota = jax.lax.broadcasted_iota(jnp.int32, (1, G, 1), 1)
+        gvec = jj * GP
+
+        # ---- helpers ----------------------------------------------------
+        # (1, G, 1) per-window scalar-vectors are the working currency;
+        # extracts are masked sums (zero elsewhere), so indices must be in
+        # range — callers clamp.
+
+        def glob(x):
+            return rr if x.shape[-3] == NC else jj
+
+        def lanes_of(x):
+            return lane_n if x.shape[-3] == NC else lane_j
+
+        def ex(val, r):
+            """val (C,G,128) at global index r (shared scalar) -> (1,G,1).
+            """
+            c = jax.lax.dynamic_slice_in_dim(val, r // 128, 1, 0)[0]
+            m = lane1 == (r % 128)
+            return jnp.sum(jnp.where(m, c, jnp.zeros_like(c)), axis=-1,
+                           keepdims=True)[None]
+
+        def ex_v(val, rv):
+            """val (C,G,128) at per-window indices rv (1,G,1)."""
+            m = glob(val) == rv
+            return jnp.sum(jnp.where(m, val, jnp.zeros_like(val)),
+                           axis=(0, 2), keepdims=True)[:, :, 0:1]
+
+        def rmw(ref, r, v, active):
+            """ref value at shared scalar index r <- v where active."""
+            c = ref[pl.ds(r // 128, 1)]
+            m = (lane1 == (r % 128))[None] & active
+            ref[pl.ds(r // 128, 1)] = jnp.where(m, v, c)
+
+        def rmw_v(ref, rv, v, active):
+            """masked write at per-window global indices rv (1,G,1)."""
+            ref[...] = jnp.where((glob(ref[...]) == rv) & active, v,
+                                 ref[...])
+
+        def shift_right(x, fill):
+            """lane shift: out[i] = x[i-1], out[0] = fill (global index)."""
+            ln = pltpu.roll(x, 1, 2)
+            carry = pltpu.roll(ln, 1, 0)
+            y = jnp.where(lanes_of(x) == 0, carry, ln)
+            return jnp.where(glob(x) == 0, fill, y)
+
+        def shift_left_dyn(x, d, fill):
+            """out[i] = x[i + d] (dynamic scalar d >= 0), fill past the
+            end; crosses lane chunks."""
+            dl = d % 128
+            dc = d // 128
+            xs = pltpu.roll(x, -dl, 2)
+            xc = pltpu.roll(xs, -dc, 0)
+            xc2 = pltpu.roll(xs, -(dc + 1), 0)
+            y = jnp.where(lanes_of(x) < 128 - dl, xc, xc2)
+            top = x.shape[-3] * 128
+            return jnp.where(glob(x) + d < top, y, fill)
+
+        def cummaxj(x):
+            """prefix max over the global j index of a (JC,G,128) array:
+            radix-4 within lanes, then an exclusive chunk prefix."""
+            w = 1
+            while w < 128:
+                for k in (1, 2, 3):
+                    if k * w < 128:
+                        x = jnp.maximum(
+                            x, jnp.where(lane_j >= k * w,
+                                         pltpu.roll(x, k * w, 2), NEG))
+                w *= 4
+            tot = jnp.max(x, axis=2, keepdims=True)
+            p = jnp.broadcast_to(tot, (JC, G, 128))
+            acc = jnp.full((JC, G, 128), NEG, jnp.int32)
+            for k in range(1, JC):
+                acc = jnp.maximum(
+                    acc, jnp.where(chunk_j >= k, pltpu.roll(p, k, 0), NEG))
+            return jnp.maximum(x, acc)
+
+        def scalar_of(v, g):
+            return jnp.sum(jnp.where(giota == g, v, jnp.zeros_like(v)))
+
+        def svec(read):
+            """(1,G,1) vector from G SMEM scalars (SMEM is scalar-only)."""
+            v = jnp.zeros((1, G, 1), jnp.int32)
+            for g in range(G):
+                v = jnp.where(giota == g, read(g), v)
+            return v
+
+        bb_len = svec(lambda g: bb_len_s[0, g])
+        n_layers = svec(lambda g: n_layers_s[0, g])
+        max_layers = jnp.max(n_layers)
+
+        # ---- graph init from the backbone chain ------------------------
+        # (parity: rt_poa.cpp add_alignment, empty-alignment branch)
+        used0 = rr < bb_len
+        rk_base[...] = jnp.where(used0, bb_ref[0], -1)
+        rk_key[...] = jnp.where(used0, rr.astype(jnp.float32), KEY_INF)
+        rk_cov[...] = jnp.where(used0, 1, 0)
+        chain = (rr > 0) & used0
+        rk_cnt[...] = jnp.where(chain, 1, 0)
+        rk_delta[...] = jnp.zeros((E, NC, G, 128), jnp.int32)
+        rk_delta[0:1] = jnp.where(chain, 1, 0)[None]
+        bbw = bbw_ref[0]
+        rk_ew[...] = jnp.zeros((E, NC, G, 128), jnp.int32)
+        rk_ew[0:1] = jnp.where(chain, shift_right(bbw, 0) + bbw, 0)[None]
+        H0[...] = gvec
+
+        def start_copy(li, slot):
+            pltpu.make_async_copy(seqs_hbm.at[b_prog, li],
+                                  seq_scr.at[slot],
+                                  dma_sem.at[slot, 0]).start()
+            pltpu.make_async_copy(ws_hbm.at[b_prog, li],
+                                  w_scr.at[slot],
+                                  dma_sem.at[slot, 1]).start()
+
+        def wait_copy(li, slot):
+            pltpu.make_async_copy(seqs_hbm.at[b_prog, li],
+                                  seq_scr.at[slot],
+                                  dma_sem.at[slot, 0]).wait()
+            pltpu.make_async_copy(ws_hbm.at[b_prog, li],
+                                  w_scr.at[slot],
+                                  dma_sem.at[slot, 1]).wait()
+
+        def flush_chunk(c):
+            pltpu.make_async_copy(
+                Hring.at[pl.ds((c * BLK) % RING, BLK)],
+                hbm_H.at[b_prog, pl.ds(c * BLK, BLK)],
+                flush_sem.at[c % 2]).start()
+
+        def flush_wait(c):
+            pltpu.make_async_copy(
+                Hring.at[pl.ds((c * BLK) % RING, BLK)],
+                hbm_H.at[b_prog, pl.ds(c * BLK, BLK)],
+                flush_sem.at[c % 2]).wait()
+
+        # ================= one layer =====================================
+        def do_layer(li, slot, carry):
+            n, failed = carry                          # (1,G,1) i32
+            Ln = svec(lambda g: lens_s[0, g, li])
+            begin = svec(lambda g: begins_s[0, g, li])
+            end = svec(lambda g: ends_s[0, g, li])
+            lact = (li < n_layers) & (Ln > 0) & (failed == 0)
+
+            # full-graph rule (reference: src/window.cpp:88-97)
+            offset = (0.01 * bb_len.astype(jnp.float32)).astype(jnp.int32)
+            full = (begin < offset) & (end > bb_len - offset)
+            lo = jnp.where(full, jnp.float32(-KEY_INF),
+                           begin.astype(jnp.float32))
+            hi = jnp.where(full, jnp.float32(KEY_INF),
+                           end.astype(jnp.float32))
+
+            keys = rk_key[...]
+            r_lo = jnp.sum(jnp.where(keys < lo, 1, 0), axis=(0, 2),
+                           keepdims=True)[:, :, 0:1]
+            r_hi = jnp.minimum(
+                jnp.sum(jnp.where(keys <= hi, 1, 0), axis=(0, 2),
+                        keepdims=True)[:, :, 0:1], n)
+            r_start = jnp.min(jnp.where(lact, r_lo, N))
+            r_end = jnp.max(jnp.where(lact, r_hi, 0))
+
+            seqv = seq_scr[pl.ds(slot, 1)][0]          # (JC, G, 128)
+            wv = w_scr[pl.ds(slot, 1)][0]
+            seqm1 = shift_right(seqv, 255)             # lane j: seq[j-1]
+            rk_dmax[...] = jnp.max(rk_delta[...], axis=0)
+
+            # layer-invariant snapshots (the graph does not change during
+            # DP + traceback; Mosaic keeps these as VMEM-backed values)
+            base_v = rk_base[...]
+            key_v = rk_key[...]
+            cnt_v = rk_cnt[...]
+            dmax_v = rk_dmax[...]
+            delta_v = [rk_delta[e] for e in range(E)]
+            H0v = H0[...]
+
+            # distance cap: an IN-SUBGRAPH edge beyond DMAX fails the
+            # window (its H row is evicted from the ring; the host path
+            # takes over — the rank-distance histograms say this is rare)
+            in_sub = (rr >= r_lo) & (rr < r_hi)
+            far = jnp.zeros((1, G, 1), jnp.int32)
+            for e in range(E):
+                bad = ((delta_v[e] > DMAX) & in_sub &
+                       ((rr - delta_v[e]) >= r_lo))
+                far = far | jnp.any(bad, axis=(0, 2),
+                                    keepdims=True)[:, :, 0:1].astype(
+                    jnp.int32)
+            failed = failed | jnp.where(lact & (far > 0), 1, 0)
+
+            esc[...] = jnp.full((NC, G, 128), NEG, jnp.int32)
+
+            # ---- DP over ranks in lock-step -----------------------------
+            def dp_body(r, _):
+                act = lact & (r >= r_lo) & (r < r_hi)
+                dmax_r = jnp.minimum(jnp.max(ex(dmax_v, r)), DMAX)
+                dmax_r = jnp.minimum(dmax_r, r)
+                ds = []
+                for e in range(E):
+                    d_e = ex(delta_v[e], r)
+                    valid = ((d_e > 0) & (d_e <= DMAX) &
+                             (r - d_e >= r_lo) & act)
+                    ds.append(jnp.where(valid, d_e, 0))
+                any_valid = ds[0] > 0
+                for e in range(1, E):
+                    any_valid = any_valid | (ds[e] > 0)
+
+                def delta_scan(d, P):
+                    prow = Hring[pl.ds((r - d) % RING, 1)][0]
+                    has = ds[0] == d
+                    for e in range(1, E):
+                        has = has | (ds[e] == d)
+                    return jnp.where(has, jnp.maximum(P, prow), P)
+
+                P0 = jnp.full((JC, G, 128), NEG, jnp.int32)
+                P = jax.lax.fori_loop(1, dmax_r + 1, delta_scan, P0)
+                P = jnp.where(any_valid, P, H0v)
+
+                ub = ex(base_v, r)
+                scvec = jnp.where(seqm1 == ub, M, X)
+                diag = shift_right(P, NEG) + scvec
+                up = P + GP
+                V = jnp.maximum(diag, up)
+                row = cummaxj(V - gvec) + gvec
+                Hring[pl.ds(r % RING, 1)] = row[None]
+                rmw(esc, r, ex_v(row, Ln), act)
+
+                @pl.when((r + 1) % BLK == 0)
+                def _():
+                    flush_chunk((r + 1) // BLK - 1)
+                    # the chunk whose ring slots ranks [r+1, r+1+BLK)
+                    # will overwrite must have landed in HBM
+                    @pl.when(r + 1 >= RING)
+                    def _():
+                        flush_wait((r + 1 - RING) // BLK)
+                return 0
+
+            rs64 = (r_start // BLK) * BLK
+            jax.lax.fori_loop(rs64, r_end, dp_body, 0)
+
+            @pl.when(r_end % BLK != 0)
+            def _():
+                flush_chunk(r_end // BLK)
+
+            n_chunks = (r_end + BLK - 1) // BLK - rs64 // BLK
+
+            @pl.when(n_chunks >= 1)
+            def _():
+                flush_wait(rs64 // BLK + n_chunks - 1)
+
+            @pl.when(n_chunks >= 2)
+            def _():
+                flush_wait(rs64 // BLK + n_chunks - 2)
+
+            # ---- end-node selection -------------------------------------
+            # rank r is an end node iff no in-subgraph node has an edge
+            # from it (v2 fused this into the DP; here one masked dynamic
+            # shift per distance serves every rank at once)
+            dmax_all = jnp.minimum(
+                jnp.max(jnp.where(in_sub, dmax_v, 0)), DMAX)
+
+            def out_body(d, hm):
+                has_d = delta_v[0] == d
+                for e in range(1, E):
+                    has_d = has_d | (delta_v[e] == d)
+                src_ok = has_d & in_sub & ((rr - d) >= r_lo)
+                return hm | shift_left_dyn(src_ok.astype(jnp.int32), d, 0)
+
+            has_out = jax.lax.fori_loop(
+                1, dmax_all + 1, out_body,
+                jnp.zeros((NC, G, 128), jnp.int32))
+            endok = in_sub & (has_out == 0)
+
+            escv = jnp.where(endok, esc[...], NEG)
+            best_s = jnp.max(escv, axis=(0, 2), keepdims=True)[:, :, 0:1]
+            best_r = jnp.min(jnp.where((escv == best_s) & endok, rr, N),
+                             axis=(0, 2), keepdims=True)[:, :, 0:1]
+            has_end = best_s > NEG
+            failed = failed | jnp.where(lact & ~has_end, 1, 0)
+
+            # ---- traceback: block-descending re-derivation --------------
+            walking = lact & has_end & (failed == 0)
+            cur = jnp.where(walking, best_r, -1)
+            jcur = jnp.where(walking, Ln, 0)
+            nk0 = jnp.full((1, G, 1), KEY_INF, jnp.float32)
+            run0 = jnp.zeros((1, G, 1), jnp.int32)
+            done0 = ~walking
+            b_top = jnp.max(jnp.where(done0, 0, cur)) // BLK
+
+            def tb_load(b, half):
+                pltpu.make_async_copy(
+                    hbm_H.at[b_prog, pl.ds(b * BLK, BLK)],
+                    Hring.at[pl.ds(half * BLK, BLK)],
+                    tb_sem.at[half]).start()
+
+            def tb_wait(b, half):
+                pltpu.make_async_copy(
+                    hbm_H.at[b_prog, pl.ds(b * BLK, BLK)],
+                    Hring.at[pl.ds(half * BLK, BLK)],
+                    tb_sem.at[half]).wait()
+
+            def ring_row(p):
+                """resident spill row for rank p (blocks b and b-1)."""
+                return Hring[pl.ds(((p // BLK) % 2) * BLK + p % BLK, 1)][0]
+
+            tb_load(b_top, b_top % 2)
+            tb_wait(b_top, b_top % 2)
+
+            @pl.when(b_top >= 1)
+            def _():
+                tb_load(b_top - 1, (b_top - 1) % 2)
+
+            def tb_rank_work(r, c):
+                cur, jcur, nk, run, done, failed = c
+                here = ~done & (cur == r)
+                row = ring_row(r)
+                ub = ex(base_v, r)
+                scv = jnp.where(seqm1 == ub, M, X)
+                ds = []
+                for e in range(E):
+                    d_e = ex(delta_v[e], r)
+                    valid = (d_e > 0) & (d_e <= DMAX) & (r - d_e >= r_lo)
+                    ds.append(jnp.where(valid, d_e, 0))
+                any_v = ds[0] > 0
+                for e in range(1, E):
+                    any_v = any_v | (ds[e] > 0)
+                dmax_r = jnp.minimum(jnp.max(ex(dmax_v, r)), DMAX)
+                dmax_r = jnp.minimum(dmax_r, r)
+
+                # min over (slot, delta) packed as slot*256+delta: the
+                # winning predecessor is the FIRST slot whose row explains
+                # the H value (host tie-break: edge insertion order)
+                def mscan(d, c2):
+                    wdiag, wup = c2
+                    prow = ring_row(r - d)
+                    s_of_d = jnp.full((1, G, 1), BIG, jnp.int32)
+                    for e in range(E - 1, -1, -1):
+                        s_of_d = jnp.where(ds[e] == d, e, s_of_d)
+                    has = s_of_d < BIG
+                    pk = s_of_d * 256 + d
+                    dm = has & (shift_right(prow, NEG) + scv == row)
+                    um = has & (prow + GP == row)
+                    wdiag = jnp.minimum(wdiag, jnp.where(dm, pk, WNONE))
+                    wup = jnp.minimum(wup, jnp.where(um, pk, WNONE))
+                    return (wdiag, wup)
+
+                W0 = jnp.full((JC, G, 128), WNONE, jnp.int32)
+                wdiag, wup = jax.lax.fori_loop(1, dmax_r + 1, mscan,
+                                               (W0, W0))
+                vdiag = ~any_v & (shift_right(H0v, NEG) + scv == row)
+                vup = ~any_v & (H0v + GP == row)
+                diag_ok = (wdiag < WNONE) | vdiag
+                ok = diag_ok | (wup < WNONE) | vup
+
+                # insertion run: walk left to the nearest explained cell
+                okm = ok & (jj <= jcur) & here
+                j_stop = jnp.max(jnp.where(okm, jj, -1), axis=(0, 2),
+                                 keepdims=True)[:, :, 0:1]
+                stuck = here & (j_stop < 0)
+                failed = failed | jnp.where(stuck, 1, 0)
+                done = done | stuck
+                act = here & ~stuck
+                j_stop = jnp.maximum(j_stop, 0)
+
+                lanes = (jj >= j_stop) & (jj < jcur) & act
+                runrem[...] = jnp.where(lanes, run + (jcur - jj),
+                                        runrem[...])
+                nkey[...] = jnp.where(lanes, nk, nkey[...])
+                run = jnp.where(act, run + (jcur - j_stop), run)
+
+                # the descending move at j_stop (diag > up priority)
+                take_diag = act & (ex_v(
+                    jnp.where(diag_ok, 1, 0), j_stop) == 1)
+                wd = ex_v(jnp.where(wdiag == WNONE, 0, wdiag), j_stop)
+                wd_virt = ex_v(jnp.where(wdiag == WNONE, 1, 0),
+                               j_stop) == 1
+                wu = ex_v(jnp.where(wup == WNONE, 0, wup), j_stop)
+                wu_virt = ex_v(jnp.where(wup == WNONE, 1, 0), j_stop) == 1
+                take_up = act & ~take_diag
+
+                kr = ex(key_v, r)
+                nk = jnp.where(take_diag, kr, nk)
+                mlane = (jj == j_stop - 1) & take_diag
+                runrem[...] = jnp.where(mlane, 0, runrem[...])
+                nkey[...] = jnp.where(mlane, kr, nkey[...])
+                run = jnp.where(take_diag, 0, run)
+                jcur = jnp.where(take_diag, j_stop - 1,
+                                 jnp.where(take_up, j_stop, jcur))
+
+                new_cur = jnp.where(
+                    take_diag,
+                    jnp.where(wd_virt, -1, r - wd % 256),
+                    jnp.where(wu_virt, -1, r - wu % 256))
+                cur = jnp.where(act, new_cur, cur)
+
+                # a window that reached the virtual row finishes its
+                # remaining insertions in one masked write
+                at_virt = act & (cur == -1)
+                vl = (jj < jcur) & at_virt
+                runrem[...] = jnp.where(vl, run + (jcur - jj), runrem[...])
+                nkey[...] = jnp.where(vl, nk, nkey[...])
+                done = done | at_virt
+                return (cur, jcur, nk, run, done, failed)
+
+            def tb_rank(i, c):
+                b = c[0]
+                r = b * BLK + (BLK - 1 - i)
+                cc = c[1:]
+                here_any = jnp.any(~cc[4] & (cc[0] == r))
+                cc2 = jax.lax.cond(here_any,
+                                   lambda cc: tb_rank_work(r, cc),
+                                   lambda cc: cc, cc)
+                return (b,) + cc2
+
+            def tb_block(i, c):
+                b = b_top - i
+
+                @pl.when(b >= 1)
+                def _():
+                    tb_wait(b - 1, (b - 1) % 2)
+
+                c2 = jax.lax.fori_loop(0, BLK, tb_rank, (b,) + c)[1:]
+
+                @pl.when(b >= 2)
+                def _():
+                    tb_load(b - 2, b % 2)
+                return c2
+
+            cur, jcur, nk, run, done, failed = jax.lax.fori_loop(
+                0, b_top + 1, tb_block,
+                (cur, jcur, nk0, run0, done0, failed))
+            failed = failed | jnp.where(~done & lact, 1, 0)
+
+            # ---- graph update (parity: rt_poa.cpp add_alignment) --------
+            maxL = jnp.max(jnp.where(lact & (failed == 0), Ln, 0))
+            runrem_v = runrem[...]
+            nkey_v = nkey[...]
+
+            def upd_body(j, c):
+                n, failed, prev_r, prev_key, prev_w = c
+                act = lact & (j < Ln) & (failed == 0)
+                b = ex(seqv, j)
+                wj = ex(wv, j)
+                run_j = ex(runrem_v, j)
+                nk_j = ex(nkey_v, j)
+                is_match = (run_j == 0) & act
+                k0 = nk_j
+
+                keys = rk_key[...]
+                basev = rk_base[...]
+                cand = (keys == k0) & (basev == b)
+                has = jnp.any(cand, axis=(0, 2),
+                              keepdims=True)[:, :, 0:1] & is_match
+                found = jnp.min(jnp.where(cand, rr, N), axis=(0, 2),
+                                keepdims=True)[:, :, 0:1]
+
+                runf = run_j.astype(jnp.float32)
+                hi2 = jnp.where(nk_j < KEY_INF, nk_j, prev_key + 1.0)
+                lo2 = jnp.where(prev_r >= 0, prev_key, hi2 - runf - 1.0)
+                k_new = lo2 + (hi2 - lo2) / (runf + 1.0)
+                key_val = jnp.where(is_match, k0, k_new)
+
+                need_new = act & ~has
+                overflow = need_new & (n >= N)
+                do_new = need_new & ~overflow
+                p_ins = jnp.sum(jnp.where(keys <= key_val, 1, 0),
+                                axis=(0, 2), keepdims=True)[:, :, 0:1]
+                nid = jnp.where(has, found, jnp.minimum(p_ins, N - 1))
+
+                @pl.when(jnp.any(do_new))
+                def _():
+                    sh = (rr >= p_ins) & do_new
+                    v = rk_base[...]
+                    rk_base[...] = jnp.where(sh, shift_right(v, -1), v)
+                    v = rk_cov[...]
+                    rk_cov[...] = jnp.where(sh, shift_right(v, 0), v)
+                    v = rk_cnt[...]
+                    rk_cnt[...] = jnp.where(sh, shift_right(v, 0), v)
+                    vk = rk_key[...]
+                    rk_key[...] = jnp.where(sh, shift_right(vk, KEY_INF),
+                                            vk)
+                    for e in range(E):
+                        vd = rk_delta[e]
+                        sd = shift_right(vd, 0)
+                        # an edge whose source sits below the insertion
+                        # point now spans it: distance grows by one
+                        sd = sd + jnp.where(
+                            (sd > 0) & (rr - 1 - sd < p_ins), 1, 0)
+                        rk_delta[e] = jnp.where(sh, sd, vd)
+                        vw = rk_ew[e]
+                        rk_ew[e] = jnp.where(sh, shift_right(vw, 0), vw)
+                    rmw_v(rk_base, p_ins, b, do_new)
+                    rmw_v(rk_key, p_ins, key_val, do_new)
+                    rmw_v(rk_cov, p_ins, 0, do_new)
+                    rmw_v(rk_cnt, p_ins, 0, do_new)
+                    for e in range(E):
+                        rmw_v(rk_delta[e], p_ins, 0, do_new)
+                        rmw_v(rk_ew[e], p_ins, 0, do_new)
+
+                touch = act & ~overflow
+                rmw_v(rk_cov, nid, ex_v(rk_cov[...], nid) + 1, touch)
+                n = n + jnp.where(do_new, 1, 0)
+                failed = failed | jnp.where(overflow, 1, 0)
+
+                # edge prev -> nid with weight w[j-1] + w[j]
+                prev_r = prev_r + jnp.where(do_new & (prev_r >= p_ins),
+                                            1, 0)
+                has_prev = touch & (prev_r >= 0)
+                d_tgt = nid - prev_r
+                cntv = ex_v(rk_cnt[...], nid)
+                cnt_max = jnp.max(jnp.where(has_prev, cntv, 0))
+
+                def same_scan(e, s):
+                    de = ex_v(rk_delta[pl.ds(e, 1)][0], nid)
+                    return jnp.where((s < 0) & (e < cntv) & (de == d_tgt),
+                                     e, s)
+
+                same = jax.lax.fori_loop(
+                    0, cnt_max, same_scan,
+                    jnp.full((1, G, 1), -1, jnp.int32))
+                ew = prev_w + wj
+                add_new = has_prev & (same < 0) & (cntv < E)
+
+                def eslot_write(e, _):
+                    m_same = has_prev & (same == e)
+                    m_new = add_new & (cntv == e)
+                    roww = rk_ew[pl.ds(e, 1)][0]
+                    rk_ew[pl.ds(e, 1)] = jnp.where(
+                        (rr == nid) & (m_same | m_new),
+                        jnp.where(m_same, roww + ew, ew), roww)[None]
+                    rowd = rk_delta[pl.ds(e, 1)][0]
+                    rk_delta[pl.ds(e, 1)] = jnp.where(
+                        (rr == nid) & m_new, d_tgt, rowd)[None]
+                    return 0
+
+                slot_hi = jnp.maximum(
+                    cnt_max, jnp.max(jnp.where(add_new, cntv + 1, 0)))
+                jax.lax.fori_loop(0, slot_hi, eslot_write, 0)
+                rmw_v(rk_cnt, nid, cntv + 1, add_new)
+                failed = failed | jnp.where(
+                    has_prev & (same < 0) & (cntv >= E), 1, 0)
+
+                prev_r = jnp.where(act, nid, prev_r)
+                prev_key = jnp.where(act, key_val, prev_key)
+                prev_w = jnp.where(act, wj, prev_w)
+                return (n, failed, prev_r, prev_key, prev_w)
+
+            n, failed, _, _, _ = jax.lax.fori_loop(
+                0, maxL, upd_body,
+                (n, failed,
+                 jnp.full((1, G, 1), -1, jnp.int32),
+                 jnp.full((1, G, 1), -1.0, jnp.float32),
+                 jnp.zeros((1, G, 1), jnp.int32)))
+            return (n, failed)
+
+        @pl.when(max_layers > 0)
+        def _():
+            start_copy(0, 0)
+
+        def layer_loop(li, carry):
+            slot = jax.lax.rem(li, 2)
+            wait_copy(li, slot)
+
+            @pl.when(li + 1 < max_layers)
+            def _():
+                start_copy(li + 1, jax.lax.rem(li + 1, 2))
+
+            return do_layer(li, slot, carry)
+
+        n, failed = jax.lax.fori_loop(
+            0, max_layers, layer_loop,
+            (bb_len, jnp.zeros((1, G, 1), jnp.int32)))
+
+        # ================= consensus =====================================
+        # (parity: rt_poa.cpp generate_consensus — heaviest bundle)
+        score[...] = jnp.zeros((NC, G, 128), jnp.int32)
+        spred[...] = jnp.full((NC, G, 128), -1, jnp.int32)
+        n_max = jnp.max(n)
+        cnt_f_v = rk_cnt[...]
+        delta_f = [rk_delta[e] for e in range(E)]
+        ew_f = [rk_ew[e] for e in range(E)]
+
+        def score_body(r, c):
+            best_r, best_s = c
+            act = r < n
+            cnt_r = ex(cnt_f_v, r)
+            bw = jnp.full((1, G, 1), NEG, jnp.int32)
+            bs = jnp.full((1, G, 1), NEG, jnp.int32)
+            bp = jnp.full((1, G, 1), -1, jnp.int32)
+            for e in range(E):
+                d_e = ex(delta_f[e], r)
+                w_e = ex(ew_f[e], r)
+                valid = (d_e > 0) & (e < cnt_r)
+                s_e = ex_v(score[...], jnp.clip(r - d_e, 0, N - 1))
+                better = valid & ((w_e > bw) | ((w_e == bw) & (s_e > bs)))
+                bw = jnp.where(better, w_e, bw)
+                bs = jnp.where(better, s_e, bs)
+                bp = jnp.where(better, r - d_e, bp)
+            s = jnp.where(bp >= 0, bw + bs, 0)
+            rmw(score, r, s, act)
+            rmw(spred, r, bp, act)
+            better = act & (s > best_s)
+            return (jnp.where(better, r, best_r),
+                    jnp.where(better, s, best_s))
+
+        summit, _ = jax.lax.fori_loop(
+            0, n_max, score_body,
+            (jnp.zeros((1, G, 1), jnp.int32),
+             jnp.full((1, G, 1), NEG, jnp.int32)))
+
+        # backward walk to a source (ranks into revbuf)
+        def bcond(c):
+            u, cnt = c
+            return jnp.any((u >= 0) & (cnt < N))
+
+        def bbody(c):
+            u, cnt = c
+            act = (u >= 0) & (cnt < N)
+            rmw_v(revbuf, cnt, u, act)
+            pu = ex_v(spred[...], jnp.maximum(u, 0))
+            return (jnp.where(act, pu, u),
+                    cnt + jnp.where(act, 1, 0))
+
+        _, cnt_b = jax.lax.while_loop(
+            bcond, bbody, (summit, jnp.zeros((1, G, 1), jnp.int32)))
+
+        cons_base_ref[0] = jnp.full((NC, G, 128), -1, jnp.int32)
+        cons_cov_ref[0] = jnp.zeros((NC, G, 128), jnp.int32)
+        base_f = rk_base[...]
+        cov_f = rk_cov[...]
+
+        def emit(i, u, act):
+            bv = ex_v(base_f, u)
+            cv = ex_v(cov_f, u)
+            m = (rr == i) & act
+            cons_base_ref[0] = jnp.where(m, bv, cons_base_ref[0])
+            cons_cov_ref[0] = jnp.where(m, cv, cons_cov_ref[0])
+
+        def flip_body(i, _):
+            act = i < cnt_b
+            u = ex_v(revbuf[...], jnp.clip(cnt_b - 1 - i, 0, N - 1))
+            emit(i, jnp.clip(u, 0, N - 1), act)
+            return 0
+
+        jax.lax.fori_loop(0, jnp.max(cnt_b), flip_body, 0)
+
+        # forward walk to a sink along heaviest out-edges
+        def fcond(c):
+            u, cnt, more = c
+            return jnp.any(more)
+
+        def fbody(c):
+            u, cnt, more = c
+            ew = jnp.full((NC, G, 128), NEG, jnp.int32)
+            for e in range(E):
+                m = ((delta_f[e] > 0) & (delta_f[e] == rr - u) &
+                     (rr < n))
+                ew = jnp.maximum(ew, jnp.where(m, ew_f[e], NEG))
+            wmax = jnp.max(ew, axis=(0, 2), keepdims=True)[:, :, 0:1]
+            any_out = more & (wmax > NEG)
+            cand_s = jnp.where(ew == wmax, score[...], NEG)
+            smax = jnp.max(cand_s, axis=(0, 2), keepdims=True)[:, :, 0:1]
+            v = jnp.min(jnp.where(cand_s == smax, rr, N), axis=(0, 2),
+                        keepdims=True)[:, :, 0:1]
+            emit(cnt, jnp.clip(v, 0, N - 1), any_out)
+            return (jnp.where(any_out, v, u),
+                    cnt + jnp.where(any_out, 1, 0), any_out)
+
+        _, cnt_f, _ = jax.lax.while_loop(
+            fcond, fbody,
+            (summit, cnt_b,
+             jnp.broadcast_to(jnp.bool_(True), (1, G, 1))))
+
+        for g in range(G):
+            cl_s[0, g] = scalar_of(cnt_f, g)
+            fl_s[0, g] = jnp.where(scalar_of(failed, g) > 0, 1, 0)
+            nn_s[0, g] = scalar_of(n, g)
+
+    def make(batch: int):
+        assert batch % G == 0
+        nb = batch // G
+        smem2 = pl.BlockSpec((1, G), lambda b: (b, 0),
+                             memory_space=pltpu.SMEM)
+        smem3 = pl.BlockSpec((1, G, D), lambda b: (b, 0, 0),
+                             memory_space=pltpu.SMEM)
+        vblk = pl.BlockSpec((1, NC, G, 128), lambda b: (b, 0, 0, 0),
+                            memory_space=pltpu.VMEM)
+        hbm = pl.BlockSpec(memory_space=pltpu.ANY)
+
+        return pl.pallas_call(
+            kernel,
+            grid=(nb,),
+            in_specs=[smem2, smem2, smem3, smem3, smem3, vblk, vblk,
+                      hbm, hbm],
+            out_specs=[vblk, vblk, smem2, smem2, smem2, hbm],
+            out_shape=[
+                jax.ShapeDtypeStruct((nb, NC, G, 128), jnp.int32),
+                jax.ShapeDtypeStruct((nb, NC, G, 128), jnp.int32),
+                jax.ShapeDtypeStruct((nb, G), jnp.int32),
+                jax.ShapeDtypeStruct((nb, G), jnp.int32),
+                jax.ShapeDtypeStruct((nb, G), jnp.int32),
+                jax.ShapeDtypeStruct((nb, N, JC, G, 128), jnp.int32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((RING, JC, G, 128), jnp.int32),   # Hring
+                pltpu.VMEM((JC, G, 128), jnp.int32),         # H0
+                pltpu.VMEM((NC, G, 128), jnp.int32),         # rk_base
+                pltpu.VMEM((NC, G, 128), jnp.float32),       # rk_key
+                pltpu.VMEM((NC, G, 128), jnp.int32),         # rk_cov
+                pltpu.VMEM((NC, G, 128), jnp.int32),         # rk_cnt
+                pltpu.VMEM((E, NC, G, 128), jnp.int32),      # rk_delta
+                pltpu.VMEM((E, NC, G, 128), jnp.int32),      # rk_ew
+                pltpu.VMEM((NC, G, 128), jnp.int32),         # rk_dmax
+                pltpu.VMEM((NC, G, 128), jnp.int32),         # esc
+                pltpu.VMEM((NC, G, 128), jnp.int32),         # score
+                pltpu.VMEM((NC, G, 128), jnp.int32),         # spred
+                pltpu.VMEM((NC, G, 128), jnp.int32),         # revbuf
+                pltpu.VMEM((JC, G, 128), jnp.float32),       # nkey
+                pltpu.VMEM((JC, G, 128), jnp.int32),         # runrem
+                pltpu.VMEM((2, JC, G, 128), jnp.int32),      # seq_scr
+                pltpu.VMEM((2, JC, G, 128), jnp.int32),      # w_scr
+                pltpu.SemaphoreType.DMA((2, 2)),             # layer DMA
+                pltpu.SemaphoreType.DMA((2,)),               # flush
+                pltpu.SemaphoreType.DMA((2,)),               # tb load
+            ],
+            interpret=interpret,
+        )
+
+    @functools.lru_cache(maxsize=8)
+    def jitted(batch: int):
+        call = make(batch)
+        nb = batch // G
+
+        def fn(bb_len, n_layers, lens, begins, ends, bb, bbw, seqs, ws):
+            def to_n(x):
+                x = jnp.pad(x.reshape(batch, BB), ((0, 0), (0, N - BB)))
+                return x.reshape(nb, G, NC, 128).transpose(0, 2, 1, 3)
+
+            seqsJ = jnp.pad(seqs, ((0, 0), (0, 0), (0, JL - L)),
+                            constant_values=255)
+            wsJ = jnp.pad(ws, ((0, 0), (0, 0), (0, JL - L)))
+            seqsJ = seqsJ.reshape(nb, G, D, JC, 128).transpose(
+                0, 2, 3, 1, 4)
+            wsJ = wsJ.reshape(nb, G, D, JC, 128).transpose(0, 2, 3, 1, 4)
+
+            cb, cc, cl, fl, nn, _ = call(
+                bb_len.reshape(nb, G), n_layers.reshape(nb, G),
+                lens.reshape(nb, G, D), begins.reshape(nb, G, D),
+                ends.reshape(nb, G, D), to_n(bb), to_n(bbw), seqsJ, wsJ)
+            cb = cb.transpose(0, 2, 1, 3).reshape(batch, N)
+            cc = cc.transpose(0, 2, 1, 3).reshape(batch, N)
+            return (cb, cc, cl.reshape(batch, 1), fl.reshape(batch, 1),
+                    nn.reshape(batch, 1))
+
+        return jax.jit(fn)
+
+    return jitted
