@@ -33,6 +33,34 @@ def _batch_size() -> int:
     return 64 if jax.devices()[0].platform == "tpu" else 4
 
 
+def _kernel_kind() -> str:
+    """Which fused Pallas kernel serves consensus batches.
+
+    'ls' (default) — v3 lane-lockstep, 8 windows per program
+    (poa_pallas_ls.py); 'v2' — one window per program (poa_pallas.py).
+    Either degrades v2 -> XLA (and ls -> v2 -> XLA) through the same
+    lattice on Mosaic failure.
+    """
+    k = os.environ.get("RACON_TPU_POA_KERNEL", "ls")
+    if k not in ("ls", "v2"):
+        raise ValueError(
+            f"RACON_TPU_POA_KERNEL must be 'ls' or 'v2', got {k!r}")
+    return k
+
+
+def _device_batch(n_dev: int, kind: str) -> int:
+    """Batch size divisible over the mesh; the lockstep kernel additionally
+    needs the per-device batch to be a multiple of its sublane group G."""
+    from ..parallel.mesh import divisible_batch
+
+    B = divisible_batch(n_dev, _batch_size())
+    if kind == "ls":
+        from .poa_pallas_ls import G
+        q = G * n_dev
+        B = max(1, (B + q - 1) // q) * q
+    return B
+
+
 def make_config(window_length: int, depth: int, match: int, mismatch: int,
                 gap: int) -> poa.PoaConfig:
     def ceil128(x):
@@ -98,10 +126,9 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
         jobs.append((i, min(k, DEPTH_CAP)))
 
     if jobs:
-        from ..parallel.mesh import divisible_batch
-
         n_dev = _n_devices()
-        B = divisible_batch(n_dev, _batch_size())
+        kind = _kernel_kind()
+        B = _device_batch(n_dev, kind)
         use_pallas = _use_pallas()
         # Bucket by depth to bound padding waste. Layers dropped at pack
         # time (oversized/empty) only shrink a window's true depth, so a
@@ -111,31 +138,34 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
             bucket = next(b for b in DEPTH_BUCKETS if depth <= b)
             buckets.setdefault(bucket, []).append((i, depth))
 
-        pending = None  # (chunk, packed, outs, cfg, use_pallas) in flight
-        dead_geoms = set()  # configs whose pallas kernel failed at runtime
+        pending = None  # (chunk, packed, outs, cfg, pallas, kind) in flight
+        # geometries (cfg, kind) whose pallas kernel already failed —
+        # seeded from warm-up failures so the measured run never retries
+        # a kernel the warm-up proved dead
+        dead_geoms = set(_WARM_DEAD)
         for depth_bucket, bucket_jobs in sorted(buckets.items()):
             cfg = make_config(max(window_length, 1), depth_bucket, match,
                               mismatch, gap)
             # Large window geometries (e.g. -w 1000) overflow the fused
             # kernel's VMEM budget; the flag must flip HERE so _submit and
             # _unpack agree with the kernel _build_kernel actually returns.
-            bucket_pallas = use_pallas and _fits_vmem(cfg)
+            bucket_pallas, bucket_kind = _pick_tier(cfg, use_pallas, kind)
             # (Per-bucket depth is kept deliberately: the fused kernel's
             # VMEM footprint is depth-independent now, but packing and
             # host->device transfer scale with the padded depth — a single
             # DEPTH_CAP geometry would ship ~25x zeros for the shallow
             # buckets on every chunk to save compiles that the lru +
             # persistent compilation caches already amortize.)
-            kernel = _build_kernel(cfg, B, bucket_pallas)
+            kernel = _build_kernel(cfg, B, bucket_pallas, bucket_kind)
             # Sequential loops run lock-step across the batch, so keep
             # batches depth-homogeneous.
             bucket_jobs.sort(key=lambda job: job[1])
             for off in range(0, len(bucket_jobs), B):
-                if bucket_pallas and cfg in dead_geoms:
-                    # an earlier chunk of this geometry failed at drain
-                    # time: stop dispatching through the broken kernel
-                    bucket_pallas = False
-                    kernel = _build_kernel(cfg, B, False)
+                while bucket_pallas and (cfg, bucket_kind) in dead_geoms:
+                    # an earlier chunk (or the warm-up) proved this tier
+                    # dead for this geometry: step down before dispatching
+                    bucket_pallas, kernel, bucket_kind = _step_down(
+                        cfg, B, bucket_kind)
                 idxs = [i for i, _ in bucket_jobs[off:off + B]]
                 # Always pad to B: a dataset-size-dependent final-chunk
                 # shape would force an extra jit compile per distinct
@@ -145,18 +175,21 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
                 if not chunk:
                     continue
                 packed = _pack(chunk, cfg, pad)
-                try:
-                    outs = _submit(kernel, packed, bucket_pallas)
-                except Exception as e:  # noqa: BLE001
-                    if not bucket_pallas:
-                        raise
-                    dead_geoms.add(cfg)
-                    bucket_pallas, kernel = _degrade(e, cfg, B)
-                    outs = _submit(kernel, packed, bucket_pallas)
+                while True:
+                    try:
+                        outs = _submit(kernel, packed, bucket_pallas)
+                        break
+                    except Exception as e:  # noqa: BLE001
+                        if not bucket_pallas:
+                            raise
+                        dead_geoms.add((cfg, bucket_kind))
+                        bucket_pallas, kernel, bucket_kind = _degrade(
+                            e, cfg, B, bucket_kind)
                 if pending is not None:
                     _drain(pipeline, pending, trim, stats, fallback, B,
                            dead_geoms)
-                pending = (chunk, packed, outs, cfg, bucket_pallas)
+                pending = (chunk, packed, outs, cfg, bucket_pallas,
+                           bucket_kind)
             if progress:
                 print(f"[racon_tpu::poa] bucket depth<={depth_bucket}: "
                       f"{len(bucket_jobs)} windows", file=sys.stderr)
@@ -170,6 +203,12 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
     return stats
 
 
+# (cfg, kind) pairs whose pallas kernel failed during warm-up; consulted by
+# run_consensus_phase so the measured run dispatches straight to the tier
+# the warm-up landed on instead of re-paying a compile-and-fail.
+_WARM_DEAD: set = set()
+
+
 def warm_geometries(window_length: int, match: int, mismatch: int,
                     gap: int) -> None:
     """Compile (or load from the persistent cache) every kernel geometry
@@ -178,58 +217,91 @@ def warm_geometries(window_length: int, match: int, mismatch: int,
     One all-padding batch per depth bucket (1-base backbones, zero layers)
     runs in milliseconds but forces the full compile — so a benchmark's
     measured pass never pays compile time, whatever depth mix the real
-    dataset produces."""
-    from ..parallel.mesh import divisible_batch
-
+    dataset produces. Tiers that fail here are recorded in _WARM_DEAD so
+    the measured run skips them."""
     n_dev = _n_devices()
-    B = divisible_batch(n_dev, _batch_size())
+    kind = _kernel_kind()
+    B = _device_batch(n_dev, kind)
     use_pallas = _use_pallas()
     for depth_bucket in DEPTH_BUCKETS:
         cfg = make_config(max(window_length, 1), depth_bucket, match,
                           mismatch, gap)
-        bucket_pallas = use_pallas and _fits_vmem(cfg)
-        kernel = _build_kernel(cfg, B, bucket_pallas)
+        bucket_pallas, bucket_kind = _pick_tier(cfg, use_pallas, kind)
+        kernel = _build_kernel(cfg, B, bucket_pallas, bucket_kind)
         packed = _pack([], cfg, B)
-        try:
-            _unpack(_submit(kernel, packed, bucket_pallas), bucket_pallas)
-        except Exception as e:  # noqa: BLE001
-            # same degrade philosophy as run_consensus_phase: a Mosaic
-            # failure on one geometry must not abort the caller — warm the
-            # XLA tier it will actually fall back to
-            if not bucket_pallas:
-                raise
-            _, kernel = _degrade(e, cfg, B)
-            _unpack(_submit(kernel, packed, False), False)
+        while True:
+            try:
+                _unpack(_submit(kernel, packed, bucket_pallas),
+                        bucket_pallas)
+                break
+            except Exception as e:  # noqa: BLE001
+                # same degrade philosophy as run_consensus_phase: a Mosaic
+                # failure on one geometry must not abort the caller — warm
+                # the tier it will actually fall back to, and remember the
+                # failure so the measured run doesn't retry it
+                if not bucket_pallas:
+                    raise
+                _WARM_DEAD.add((cfg, bucket_kind))
+                bucket_pallas, kernel, bucket_kind = _degrade(
+                    e, cfg, B, bucket_kind)
 
 
-def _degrade(e, cfg, B):
-    """Mosaic compile/runtime failure: fall back to the XLA kernel for the
-    rest of this geometry (same philosophy as the per-window host
+def _pick_tier(cfg, use_pallas: bool, kind: str):
+    """(bucket_pallas, bucket_kind) after VMEM-fit checks: the requested
+    pallas tier if it fits, else the next tier down."""
+    if not use_pallas:
+        return False, kind
+    if _fits_vmem(cfg, kind):
+        return True, kind
+    if kind == "ls" and _fits_vmem(cfg, "v2"):
+        return True, "v2"
+    return False, kind
+
+
+def _step_down(cfg, B, kind):
+    """Next tier below (pallas `kind`) for this geometry:
+    ls -> v2 (if it fits) -> XLA. Returns (use_pallas, kernel, kind)."""
+    if kind == "ls" and _fits_vmem(cfg, "v2"):
+        return True, _build_kernel(cfg, B, True, "v2"), "v2"
+    return False, _build_kernel(cfg, B, False, kind), kind
+
+
+def _degrade(e, cfg, B, kind):
+    """Mosaic compile/runtime failure: fall back to the next kernel tier
+    for the rest of this geometry (same philosophy as the per-window host
     fallback)."""
+    use_p, kernel, new_kind = _step_down(cfg, B, kind)
+    tier = f"pallas '{new_kind}'" if use_p else "XLA"
     print("[racon_tpu::poa] WARNING: pallas kernel failed "
-          f"({type(e).__name__}: {e}); falling back to the XLA kernel",
+          f"({type(e).__name__}: {e}); falling back to the {tier} kernel",
           file=sys.stderr)
-    return False, _build_kernel(cfg, B, False)
+    return use_p, kernel, new_kind
 
 
 def _drain(pipeline, pending, trim, stats, fallback, B, dead_geoms):
     """Block on an in-flight chunk's device results and install them.
 
     If the pallas kernel failed at runtime (error surfaces at the blocking
-    transfer), re-run the chunk through the XLA kernel — the packed arrays
-    are still on hand, so no re-export is needed — and mark the geometry
-    dead so the bucket loop stops dispatching through the broken kernel.
+    transfer), re-run the chunk through the next tier down — the packed
+    arrays are still on hand, so no re-export is needed — and mark the
+    geometry dead so the bucket loop stops dispatching through the broken
+    kernel.
     """
-    chunk, packed, outs, cfg, was_pallas = pending
-    try:
-        results = _unpack(outs, was_pallas)
-    except Exception as e:  # noqa: BLE001
-        if not was_pallas:
-            raise
-        dead_geoms.add(cfg)
-        _, kernel = _degrade(e, cfg, B)
-        outs = _submit(kernel, packed, False)
-        results = _unpack(outs, False)
+    chunk, packed, outs, cfg, was_pallas, kind = pending
+    kernel = None
+    while True:
+        try:
+            if outs is None:
+                outs = _submit(kernel, packed, was_pallas)
+            results = _unpack(outs, was_pallas)
+            break
+        except Exception as e:  # noqa: BLE001
+            if not was_pallas:
+                raise
+            dead_geoms.add((cfg, kind))
+            was_pallas, kernel, kind = _degrade(e, cfg, B, kind)
+            outs = None  # re-submit inside the try: a synchronous failure
+            # of the intermediate v2 tier must also degrade, not escape
     _install(pipeline, chunk, results, trim, stats, fallback)
 
 
@@ -246,12 +318,25 @@ def _n_devices() -> int:
     return len(jax.devices())
 
 
-def _fits_vmem(cfg, budget_bytes: int = 14 << 20) -> bool:
+def _fits_vmem(cfg, kind: str = "v2", budget_bytes: int = 14 << 20) -> bool:
     """Whether the fused Pallas kernel's VMEM scratch fits the core budget.
 
-    Mirrors poa_pallas.py's blocked layout: layer arrays live in HBM and
-    stream through two DMA slots, so depth does not appear here.
+    v2 mirrors poa_pallas.py's blocked layout: layer arrays live in HBM
+    and stream through two DMA slots, so depth does not appear. ls mirrors
+    poa_pallas_ls.py's scratch_shapes: a 128-row H ring instead of the full
+    H matrix, plus rank-space graph arrays and per-layer DMA slots.
     """
+    if kind == "ls":
+        from .poa_pallas_ls import G, RING, _round_up
+
+        NC = cfg.max_nodes // 128
+        JC = _round_up(cfg.max_len + 1, 128) // 128
+        lane_bytes = G * 128 * 4
+        ring = RING * JC * lane_bytes
+        j_rows = (1 + 2 + 2 * 2) * JC * lane_bytes   # H0, nkey/runrem, scr
+        n_rows = (9 + 2 * cfg.max_edges) * NC * lane_bytes
+        io = 4 * NC * lane_bytes                      # bb/bbw in, cons out
+        return ring + j_rows + n_rows + io < budget_bytes
     from .poa_pallas import blocked_width
 
     jw8 = 8 * blocked_width(cfg.max_len + 1)
@@ -263,7 +348,7 @@ def _fits_vmem(cfg, budget_bytes: int = 14 << 20) -> bool:
     return h + mv + layer_slots + graph < budget_bytes
 
 
-def _build_kernel(cfg, B, use_pallas):
+def _build_kernel(cfg, B, use_pallas, kind: str = "v2"):
     """Single- or multi-device kernel for a B-window batch.
 
     Multi-device: batch dim sharded over the 1-D `windows` mesh — the
@@ -273,19 +358,21 @@ def _build_kernel(cfg, B, use_pallas):
     import jax
 
     n_dev = _n_devices()
-    assert not (use_pallas and not _fits_vmem(cfg)), (
+    assert not (use_pallas and not _fits_vmem(cfg, kind)), (
         "caller must check _fits_vmem before requesting the pallas kernel")
     if use_pallas:
-        from . import poa_pallas
+        if kind == "ls":
+            from .poa_pallas_ls import build_lockstep_poa_kernel as build
+        else:
+            from .poa_pallas import build_pallas_poa_kernel as build
         interp = jax.devices()[0].platform != "tpu"
         if n_dev == 1:
-            return poa_pallas.build_pallas_poa_kernel(cfg, interpret=interp)(B)
+            return build(cfg, interpret=interp)(B)
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.mesh import AXIS, device_mesh
         mesh = device_mesh()
-        local = poa_pallas.build_pallas_poa_kernel(cfg, interpret=interp)(
-            B // n_dev)
+        local = build(cfg, interpret=interp)(B // n_dev)
         spec = P(AXIS)
         return jax.jit(jax.shard_map(
             lambda *args: local(*args), mesh=mesh,

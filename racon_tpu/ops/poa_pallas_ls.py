@@ -580,9 +580,16 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                     rmw_v(rk_key, p_ins, key_val, do_new)
                     rmw_v(rk_cov, p_ins, 0, do_new)
                     rmw_v(rk_cnt, p_ins, 0, do_new)
+                    # zero the inserted row's edge slots through the ref
+                    # (a loaded slice is immutable; write like eslot_write)
+                    new_row = (rr == p_ins) & do_new
                     for e in range(E):
-                        rmw_v(rk_delta[e], p_ins, 0, do_new)
-                        rmw_v(rk_ew[e], p_ins, 0, do_new)
+                        vd2 = rk_delta[pl.ds(e, 1)][0]
+                        rk_delta[pl.ds(e, 1)] = jnp.where(
+                            new_row, 0, vd2)[None]
+                        vw2 = rk_ew[pl.ds(e, 1)][0]
+                        rk_ew[pl.ds(e, 1)] = jnp.where(
+                            new_row, 0, vw2)[None]
 
                 touch = act & ~overflow
                 rmw_v(rk_cov, nid, ex_v(rk_cov[...], nid) + 1, touch)
@@ -772,7 +779,7 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                              memory_space=pltpu.SMEM)
         vblk = pl.BlockSpec((1, NC, G, 128), lambda b: (b, 0, 0, 0),
                             memory_space=pltpu.VMEM)
-        hbm = pl.BlockSpec(memory_space=pltpu.ANY)
+        hbm = pl.BlockSpec(memory_space=pl.ANY)
 
         return pl.pallas_call(
             kernel,

@@ -49,6 +49,7 @@ def test_pallas_driver_path_end_to_end(tmp_path, monkeypatch):
             f.write(f"r{i}\t0\ttgt\t1\t60\t240M\t*\t0\t0\t{target}\t*\n")
 
     monkeypatch.setenv("RACON_TPU_PALLAS", "1")
+    monkeypatch.setenv("RACON_TPU_POA_KERNEL", "v2")
     monkeypatch.setenv("RACON_TPU_BATCH_WINDOWS", "4")
     p = racon_tpu.TpuPolisher(str(tmp_path / "reads.fasta"),
                               str(tmp_path / "ovl.sam"),
@@ -161,6 +162,7 @@ def test_pallas_failure_degrades_to_xla_kernel(tmp_path, monkeypatch,
         return make
 
     monkeypatch.setenv("RACON_TPU_PALLAS", "1")
+    monkeypatch.setenv("RACON_TPU_POA_KERNEL", "v2")
     monkeypatch.setattr("racon_tpu.ops.poa_pallas.build_pallas_poa_kernel",
                         broken_kernel)
     p = racon_tpu.TpuPolisher(str(tmp_path / "r.fasta"),
@@ -209,6 +211,7 @@ def test_pallas_runtime_failure_at_drain_degrades(tmp_path, monkeypatch,
         return make
 
     monkeypatch.setenv("RACON_TPU_PALLAS", "1")
+    monkeypatch.setenv("RACON_TPU_POA_KERNEL", "v2")
     monkeypatch.setattr("racon_tpu.ops.poa_pallas.build_pallas_poa_kernel",
                         async_broken_kernel)
     p = racon_tpu.TpuPolisher(str(tmp_path / "r.fasta"),

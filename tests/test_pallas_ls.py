@@ -1,0 +1,245 @@
+"""v3 lane-lockstep Pallas POA kernel differential tests (interpret mode on
+the CPU backend; on TPU hardware the same kernel runs compiled — the bench
+exercises that).
+
+The kernel (racon_tpu/ops/poa_pallas_ls.py) runs 8 windows per grid step in
+sublane lock-step; these tests assert lockstep == XLA twin == host oracle on
+one mixed batch covering varying lengths/depths, quality weights, partial
+spans, padding windows, and the DMAX rank-distance cap (which must fail the
+window to the host path, reproducing the reference's accelerator->CPU
+fallback lattice, /root/reference/src/cuda/cudapolisher.cpp:354-378).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from racon_tpu import native
+from racon_tpu.ops import poa, poa_pallas_ls
+from racon_tpu.ops.encoding import decode, encode
+
+from tests.test_pallas import mutate
+
+CFG = poa.PoaConfig(max_nodes=384, max_len=256, max_backbone=128,
+                    max_edges=12, depth=8, match=5, mismatch=-4, gap=-8)
+
+
+def _alloc(B, cfg):
+    return dict(
+        bb=np.zeros((B, cfg.max_backbone), np.uint8),
+        bbw=np.zeros((B, cfg.max_backbone), np.int32),
+        bb_len=np.ones(B, np.int32),
+        nl=np.zeros(B, np.int32),
+        seqs=np.zeros((B, cfg.depth, cfg.max_len), np.uint8),
+        ws=np.zeros((B, cfg.depth, cfg.max_len), np.int32),
+        lens=np.zeros((B, cfg.depth), np.int32),
+        bg=np.zeros((B, cfg.depth), np.int32),
+        en=np.zeros((B, cfg.depth), np.int32),
+    )
+
+
+def _set_window(a, b, backbone, layers, weights=None, begins=None,
+                ends=None):
+    a["bb"][b, :len(backbone)] = encode(np.frombuffer(backbone, np.uint8))
+    a["bb_len"][b] = len(backbone)
+    a["nl"][b] = len(layers)
+    for i, l in enumerate(layers):
+        a["seqs"][b, i, :len(l)] = encode(np.frombuffer(l, np.uint8))
+        a["ws"][b, i, :len(l)] = 1 if weights is None else weights[i]
+        a["lens"][b, i] = len(l)
+        a["bg"][b, i] = 0 if begins is None else begins[i]
+        a["en"][b, i] = (len(backbone) - 1) if ends is None else ends[i]
+
+
+def _run_both(a, cfg, B):
+    ls_fn = poa_pallas_ls.build_lockstep_poa_kernel(cfg, interpret=True)(B)
+    jax_fn = poa.build_poa_kernel(cfg)
+    cb, cc, cl, fl, nn = (np.asarray(x) for x in ls_fn(
+        a["bb_len"][:, None], a["nl"][:, None], a["lens"], a["bg"],
+        a["en"], a["bb"].astype(np.int32), a["bbw"],
+        a["seqs"].astype(np.int32), a["ws"]))
+    jb, jc, jl, jf, jn = (np.asarray(x) for x in jax_fn(
+        a["bb"], a["bbw"], a["bb_len"], a["nl"], a["seqs"], a["ws"],
+        a["lens"], a["bg"], a["en"]))
+    return (cb, cc, cl, fl, nn), (jb, jc, jl, jf, jn)
+
+
+def test_lockstep_matches_host_and_jax():
+    """One mixed 8-window batch: perfect reads, rising mutation/depth,
+    quality weights, partial spans, and a 1-base padding window — each
+    asserted against both the XLA twin and the host oracle (consensus,
+    coverage, and node count)."""
+    rng = random.Random(7)
+    B = 8
+    a = _alloc(B, CFG)
+    cases = {}
+
+    # w0: perfect reads
+    truth0 = bytes(rng.choice(b"ACGT") for _ in range(90))
+    _set_window(a, 0, truth0, [truth0] * 4)
+    cases[0] = (truth0, [truth0] * 4, None, None, None)
+
+    # w1..w4: rising mutation rate and depth, varying lengths
+    for b in range(1, 5):
+        truth = bytes(rng.choice(b"ACGT") for _ in range(60 + 15 * b))
+        backbone = mutate(truth, 0.05 * b, rng)
+        layers = [mutate(truth, 0.05 * b, rng) for _ in range(2 + b)]
+        _set_window(a, b, backbone, layers)
+        cases[b] = (backbone, layers, None, None, None)
+
+    # w5: per-base quality weights (not all-1) — exercises edge-weight
+    # accumulation and heaviest-bundle scoring with real magnitudes
+    truth5 = bytes(rng.choice(b"ACGT") for _ in range(80))
+    backbone5 = mutate(truth5, 0.1, rng)
+    layers5 = [mutate(truth5, 0.1, rng) for _ in range(5)]
+    w5 = [np.array([rng.randrange(1, 50) for _ in range(len(l))],
+                   np.int32) for l in layers5]
+    _set_window(a, 5, backbone5, layers5, weights=w5)
+    cases[5] = (backbone5, layers5, w5, None, None)
+
+    # w6: partial spans — layers cover only part of the backbone, so the
+    # subgraph rule (reference src/window.cpp:88-97) kicks in
+    truth6 = bytes(rng.choice(b"ACGT") for _ in range(120))
+    backbone6 = mutate(truth6, 0.08, rng)
+    half = len(backbone6) // 2
+    lay_a = mutate(truth6[:len(truth6) // 2], 0.08, rng)
+    lay_b = mutate(truth6[len(truth6) // 2:], 0.08, rng)
+    lay_c = mutate(truth6, 0.08, rng)
+    layers6 = [lay_c, lay_a, lay_b]
+    begins6 = [0, 0, half]
+    ends6 = [len(backbone6) - 1, half - 1, len(backbone6) - 1]
+    _set_window(a, 6, backbone6, layers6, begins=begins6, ends=ends6)
+    cases[6] = (backbone6, layers6, None, begins6, ends6)
+
+    # w7: padding window (1-base backbone, zero layers) — must not crash
+    # or flag failure, like the driver's pad-to-B windows
+
+    (cb, cc, cl, fl, nn), (jb, jc, jl, jf, jn) = _run_both(a, CFG, B)
+
+    assert not fl.any(), f"unexpected device failures: {fl[:, 0]}"
+    assert not jf.any()
+    for b, (backbone, layers, weights, begins, ends) in cases.items():
+        ls_cons = decode(cb[b, :cl[b, 0]])
+        jax_cons = decode(jb[b, :jl[b]])
+        quals = None
+        if weights is not None:
+            quals = [bytes((w + 33).astype(np.uint8)) for w in weights]
+        host_cons, _ = native.window_consensus(
+            backbone, [bytes(l) for l in layers], quals=quals,
+            begins=begins, ends=ends, trim=False)
+        assert ls_cons == jax_cons == host_cons, f"window {b}"
+        assert int(nn[b, 0]) == int(jn[b]), f"window {b} node count"
+        np.testing.assert_array_equal(cc[b, :cl[b, 0]], jc[b, :jl[b]],
+                                      err_msg=f"window {b} coverage")
+
+
+def test_lockstep_dmax_cap_fails_window_to_host():
+    """A window whose graph grows an in-subgraph edge with rank distance
+    beyond DMAX must raise its failed flag (-> driver host fallback), and
+    must not poison its batch-mates.
+
+    A long random *insertion* does not produce a long edge — spurious
+    matches fragment it during alignment (host telemetry: a 104-base
+    insert yields max distance 9). A deletion that CANNOT fragment does:
+    the backbone carries a 74-base all-A block while the layers contain
+    no A, so the DP is forced into one contiguous deletion and layer 1's
+    incorporation adds a single rank-distance-75 edge (> DMAX=64), which
+    layer 2's pre-DP distance check must trip."""
+    rng = random.Random(11)
+    B = 8
+    a = _alloc(B, CFG)
+
+    truth = bytes(rng.choice(b"CGT") for _ in range(50))
+    backbone = truth[:25] + b"A" * (poa_pallas_ls.DMAX + 10) + truth[25:]
+    _set_window(a, 0, backbone, [truth, truth])
+
+    # a healthy batch-mate in another sublane
+    mate = mutate(truth, 0.1, rng)
+    _set_window(a, 1, truth, [mate, mutate(truth, 0.1, rng)])
+
+    (cb, cc, cl, fl, nn), (jb, jc, jl, jf, jn) = _run_both(a, CFG, B)
+
+    assert fl[0, 0] == 1, "DMAX overflow must fail the window"
+    assert not jf[0], "the XLA twin has no DMAX cap and must succeed"
+    assert fl[1, 0] == 0, "batch-mate must be unaffected"
+    ls_cons = decode(cb[1, :cl[1, 0]])
+    jax_cons = decode(jb[1, :jl[1]])
+    assert ls_cons == jax_cons
+
+
+def test_lockstep_driver_path_end_to_end(tmp_path, monkeypatch):
+    """Full TpuPolisher flow with the lockstep branch of the consensus
+    driver (interpret mode): exercises RACON_TPU_POA_KERNEL=ls dispatch,
+    G-multiple batching, padding, marshalling, and unpacking."""
+    import random as _r
+
+    import racon_tpu
+
+    rng = _r.Random(5)
+    target = "".join(rng.choice("ACGT") for _ in range(240))
+    with open(tmp_path / "target.fasta", "w") as f:
+        f.write(f">tgt\n{target}\n")
+    with open(tmp_path / "reads.fasta", "w") as f:
+        for i in range(4):
+            f.write(f">r{i}\n{target}\n")
+    with open(tmp_path / "ovl.sam", "w") as f:
+        f.write("@HD\tVN:1.6\n")
+        for i in range(4):
+            f.write(f"r{i}\t0\ttgt\t1\t60\t240M\t*\t0\t0\t{target}\t*\n")
+
+    monkeypatch.setenv("RACON_TPU_PALLAS", "1")
+    monkeypatch.setenv("RACON_TPU_POA_KERNEL", "ls")
+    monkeypatch.setenv("RACON_TPU_BATCH_WINDOWS", "4")  # rounds up to G=8
+    p = racon_tpu.TpuPolisher(str(tmp_path / "reads.fasta"),
+                              str(tmp_path / "ovl.sam"),
+                              str(tmp_path / "target.fasta"),
+                              window_length=80, quality_threshold=10,
+                              error_threshold=0.3, match=5, mismatch=-4,
+                              gap=-8, num_threads=1)
+    p.initialize()
+    res = p.polish(True)
+    assert len(res) == 1
+    assert res[0][1] == target  # perfect reads -> perfect consensus
+
+
+def test_lockstep_ls_failure_degrades_to_v2(tmp_path, monkeypatch, capsys):
+    """A Mosaic failure in the lockstep kernel must step down to the v2
+    pallas kernel (not straight to XLA), preserving the accelerated path."""
+    import racon_tpu
+    from racon_tpu.ops import poa_driver
+
+    target = "ACGT" * 60
+    with open(tmp_path / "t.fasta", "w") as f:
+        f.write(f">t\n{target}\n")
+    with open(tmp_path / "r.fasta", "w") as f:
+        for i in range(4):
+            f.write(f">r{i}\n{target}\n")
+    with open(tmp_path / "o.sam", "w") as f:
+        f.write("@HD\tVN:1.6\n")
+        for i in range(4):
+            f.write(f"r{i}\t0\tt\t1\t60\t{len(target)}M\t*\t0\t0\t{target}"
+                    f"\t*\n")
+
+    def broken_ls(cfg, interpret=False):
+        def make(batch):
+            def call(*args):
+                raise RuntimeError("synthetic mosaic failure")
+            return call
+        return make
+
+    monkeypatch.setenv("RACON_TPU_PALLAS", "1")
+    monkeypatch.setenv("RACON_TPU_POA_KERNEL", "ls")
+    monkeypatch.setattr(
+        "racon_tpu.ops.poa_pallas_ls.build_lockstep_poa_kernel", broken_ls)
+    p = racon_tpu.TpuPolisher(str(tmp_path / "r.fasta"),
+                              str(tmp_path / "o.sam"),
+                              str(tmp_path / "t.fasta"),
+                              window_length=100, match=5, mismatch=-4,
+                              gap=-8)
+    p.initialize()
+    res = p.polish(True)
+    assert len(res) == 1
+    assert res[0][1] == target
+    assert "falling back to the pallas 'v2' kernel" in \
+        capsys.readouterr().err
