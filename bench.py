@@ -58,6 +58,28 @@ def dataset(mbp: float = MBP):
                          ("draft", "draft.fasta"))}
 
 
+def observed_window_length(draft_path: str, w: int) -> int:
+    """The window length the consensus phase will actually derive.
+
+    run_consensus_phase sizes its kernel geometry from the OBSERVED max
+    backbone length, not the nominal -w (poa_driver.py metadata pass).
+    Windows are fixed-size chunks of draft contigs, so that maximum is
+    computable from the draft FASTA alone: max over contigs of
+    min(contig_len, w). Warming the nominal w when every contig is shorter
+    would compile a geometry the measured run never uses — and pay the
+    real geometry's compile inside the timed pass."""
+    best = 1
+    cur = 0
+    with open(draft_path) as f:
+        for line in f:
+            if line.startswith(">"):
+                best = max(best, min(cur, w))
+                cur = 0
+            else:
+                cur += len(line.strip())
+    return max(best, min(cur, w))
+
+
 def device_healthy(timeout_s: int = 120) -> bool:
     """The axon TPU tunnel can wedge (device ops then hang forever); probe
     it in a subprocess so a dead tunnel can't hang the benchmark."""
@@ -71,37 +93,50 @@ def device_healthy(timeout_s: int = 120) -> bool:
         return False
 
 
-def pallas_compiles(timeout_s: int = 900) -> bool:
+def pallas_compiles(timeout_s: int = 900):
     """Bounded probe: compile + run the fused POA kernel at the production
     w=500 geometry in a subprocess. A pathological Mosaic compile would
     otherwise hang the whole bench (and can wedge the tunnel if killed
-    mid-flight — hence one bounded probe, whose result also warms the
-    persistent compilation cache for the real run)."""
-    probe = (
-        "import numpy as np, jax, sys\n"
-        "sys.path.insert(0, %r)\n"
-        "from racon_tpu.ops import poa, poa_driver, poa_pallas\n"
-        "import __graft_entry__ as g\n"
-        "cfg = poa_driver.make_config(500, 8, 5, -4, -8)\n"
-        "fn = poa_pallas.build_pallas_poa_kernel(cfg, interpret=False)(2)\n"
-        "bb, bbw, bl, nl, seqs, ws, lens, bg, en = "
-        "g._example_batch(cfg, 2, np.random.default_rng(0))\n"
-        "out = fn(bl.reshape(-1,1), nl.reshape(-1,1), lens, bg, en, "
-        "bb.astype(np.int32), bbw, seqs.astype(np.int32), ws)\n"
-        "jax.block_until_ready(out)\n"
-        "print('pallas-ok', np.asarray(out[2]).ravel().tolist())\n"
-    ) % os.path.dirname(os.path.abspath(__file__))
-    try:
-        r = subprocess.run([sys.executable, "-c", probe],
-                           capture_output=True, timeout=timeout_s, text=True)
-        if r.returncode != 0:
-            print("[bench] pallas probe failed:", r.stderr[-500:],
+    mid-flight — hence bounded probes, whose results also warm the
+    persistent compilation cache for the real run).
+
+    Mirrors the driver's degrade lattice: returns the first working pallas
+    tier ('ls' then 'v2'), or None if neither compiles — the in-process
+    lattice handles compile *errors*, but only a subprocess bound can
+    handle a compile *hang*."""
+    from racon_tpu.ops.poa_driver import _kernel_kind
+    requested = _kernel_kind()  # validates RACON_TPU_POA_KERNEL up front
+    kinds = ["ls", "v2"] if requested == "ls" else ["v2"]
+    for kind in kinds:
+        probe = (
+            "import numpy as np, jax, sys\n"
+            "sys.path.insert(0, %r)\n"
+            "from racon_tpu.ops import poa, poa_driver\n"
+            "import __graft_entry__ as g\n"
+            "kind = %r\n"
+            "cfg = poa_driver.make_config(500, 8, 5, -4, -8)\n"
+            "B = poa_driver._device_batch(poa_driver._n_devices(), kind)\n"
+            "fn = poa_driver._build_kernel(cfg, B, True, kind)\n"
+            "packed = g._example_batch(cfg, B, np.random.default_rng(0))\n"
+            "out = poa_driver._submit(fn, packed, True)\n"
+            "jax.block_until_ready(out)\n"
+            "cb, cc, cl, fl = poa_driver._unpack(out, True)\n"
+            "print('pallas-ok', kind, cl.ravel().tolist())\n"
+        ) % (os.path.dirname(os.path.abspath(__file__)), kind)
+        try:
+            r = subprocess.run([sys.executable, "-c", probe],
+                               capture_output=True, timeout=timeout_s,
+                               text=True)
+            if r.returncode == 0:
+                return kind
+            print(f"[bench] pallas '{kind}' probe failed:",
+                  r.stderr[-500:], file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"[bench] pallas '{kind}' probe exceeded {timeout_s}s",
                   file=sys.stderr)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        print(f"[bench] pallas probe exceeded {timeout_s}s; benching the "
-              "XLA device kernel instead", file=sys.stderr)
-        return False
+    print("[bench] no pallas tier compiles; benching the XLA device "
+          "kernel instead", file=sys.stderr)
+    return None
 
 
 LOG_PATH = os.environ.get(
@@ -184,20 +219,26 @@ def main():
         print(f"[bench] cpu: {bp_cpu} bp in {dt_cpu:.1f}s", file=sys.stderr)
         return
 
-    pallas_ok = pallas_compiles()
+    tier = pallas_compiles()
+    pallas_ok = tier is not None
     if not pallas_ok:
         # Bound the blast radius: the XLA device kernel is the degraded
         # tier; measure it honestly rather than hanging on Mosaic.
         os.environ["RACON_TPU_PALLAS"] = "0"
+    else:
+        os.environ["RACON_TPU_POA_KERNEL"] = tier
 
     # Warm the device path so compile time is not billed as throughput:
     # compile every consensus kernel geometry explicitly (one trivial
-    # padded batch per depth bucket), then run a small end-to-end pass for
+    # padded batch per depth bucket) at the window length the measured
+    # dataset will actually derive, then run a small end-to-end pass for
     # everything else. The persistent compilation cache keeps both warm
     # across processes — a full-size warm-up pass would triple device wall
     # at multi-Mbp bench scales.
     from racon_tpu.ops import poa_driver
-    poa_driver.warm_geometries(ARGS["window_length"], ARGS["match"],
+    warm_len = observed_window_length(paths["draft"],
+                                      ARGS["window_length"])
+    poa_driver.warm_geometries(warm_len, ARGS["match"],
                                ARGS["mismatch"], ARGS["gap"])
     run("tpu", dataset(mbp=min(MBP, 0.05)))
 
@@ -206,11 +247,12 @@ def main():
 
     mbps_tpu = bp_tpu / dt_tpu / 1e6
     mbps_cpu = bp_cpu / dt_cpu / 1e6
-    kernel_tag = "" if pallas_ok else " [XLA kernel: pallas compile failed]"
+    kernel_tag = (f" [pallas {tier}]" if pallas_ok
+                  else " [XLA kernel: pallas compile failed]")
     log_device_measurement({
         "mbp": MBP, "input": INPUT, "value": round(mbps_tpu, 4),
         "vs_baseline": round(mbps_tpu / mbps_cpu, 3),
-        "pallas": pallas_ok,
+        "pallas": pallas_ok, "kernel": tier or "xla",
         "tpu_s": round(dt_tpu, 1), "cpu_s": round(dt_cpu, 1),
     })
     print(json.dumps({

@@ -35,10 +35,15 @@ if "JAX_COMPILATION_CACHE_DIR" not in _os.environ:  # "" = explicit opt-out
         _os.environ.setdefault(
             "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
         if "jax" in _sys.modules:
-            _sys.modules["jax"].config.update(
-                "jax_compilation_cache_dir", _cache)
-            _sys.modules["jax"].config.update(
-                "jax_persistent_cache_min_compile_time_secs", 1)
+            try:
+                _sys.modules["jax"].config.update(
+                    "jax_compilation_cache_dir", _cache)
+                _sys.modules["jax"].config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 1)
+            except Exception:  # noqa: BLE001
+                # a renamed/absent config knob on some jax version must
+                # degrade to "no persistent cache", not break import
+                pass
 
 from .polisher import CpuPolisher, TpuPolisher, create_polisher  # noqa: F401
 from .pipeline import Pipeline  # noqa: F401

@@ -467,8 +467,15 @@ def _install(pipeline, chunk, results, trim, stats, fallback):
         cov = cons_cov[bi, :cl]
         out = np.asarray(codes)
         if wx.is_tgs and trim:
-            keep_mask_len = len(keep) + 1  # incorporated seqs incl. backbone
-            kept_codes = tgs_trim(out, np.asarray(cov), keep_mask_len)
+            # Threshold on the window's FULL sequence count (backbone +
+            # every layer, even ones admission dropped as oversized or
+            # beyond DEPTH_CAP) — the host rule divides by
+            # sequences.size()-1 (rt_window.cpp:113-115; reference
+            # src/window.cpp:125-146), and the reference's accelerator
+            # path trims with the same window-level count after the GPU
+            # consensus returns (src/cuda/cudabatch.cpp:199-261).
+            n_window_seqs = len(wx.lens) + 1
+            kept_codes = tgs_trim(out, np.asarray(cov), n_window_seqs)
         else:
             kept_codes = out
         pipeline.set_consensus(i, decode(kept_codes), True)

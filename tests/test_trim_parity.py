@@ -1,0 +1,88 @@
+"""Device-vs-host trim parity for windows deeper than DEPTH_CAP.
+
+The reference's accelerator path computes the low-coverage end-trim
+threshold from the WINDOW's sequence count, not from the subset of layers
+the GPU batch actually incorporated (src/cuda/cudabatch.cpp:199-261 trims
+with the same (sequences_.size()-1)/2 rule the CPU window uses,
+src/window.cpp:125-146). The device driver here admits at most
+DEPTH_CAP=200 layers per window, so for deeper windows the two counts
+diverge — this test pins the host rule.
+
+Scenario (210 layers > DEPTH_CAP): a 100-base backbone where
+- 102 layers span positions 0..79  (head + core),
+- 108 layers span positions 15..79 (core only),
+- positions 80..99 are backbone-only (tail).
+
+Full-count threshold: (211-1)/2 = 105. Head coverage is 102+1 = 103 < 105
+-> head must be trimmed (so must the tail, coverage 1). A threshold
+computed from the 200 admitted layers instead gives (201-1)/2 = 100 <= 103
+and wrongly keeps the head. Perfect reads make device and host consensus
+base-identical, so the only difference a wrong threshold can produce is
+exactly the trim extent.
+"""
+
+import random
+
+import pytest
+
+import racon_tpu
+from racon_tpu.ops.poa_driver import DEPTH_CAP
+
+N_HEAD = 102
+N_CORE = 108
+HEAD_END = 15   # core region starts here
+CORE_END = 80   # head+core reads span [0, CORE_END)
+
+
+def _write_dataset(tmp_path, truth):
+    with open(tmp_path / "target.fasta", "w") as f:
+        f.write(f">tgt\n{truth}\n")
+    head_core = truth[:CORE_END]
+    core = truth[HEAD_END:CORE_END]
+    with open(tmp_path / "reads.fasta", "w") as f:
+        for i in range(N_HEAD):
+            f.write(f">h{i}\n{head_core}\n")
+        for i in range(N_CORE):
+            f.write(f">c{i}\n{core}\n")
+        # trim only applies to TGS windows, chosen when the MEAN read
+        # length exceeds 1000 (rt_pipeline.cpp:167-171; reference
+        # src/polisher.cpp:277-278) — one long overlap-less read flips
+        # the classification without touching the window
+        f.write(">dummy_long\n" + "A" * 300000 + "\n")
+    with open(tmp_path / "ovl.sam", "w") as f:
+        f.write("@HD\tVN:1.6\n@SQ\tSN:tgt\tLN:100\n")
+        for i in range(N_HEAD):
+            f.write(f"h{i}\t0\ttgt\t1\t60\t{len(head_core)}M\t*\t0\t0\t"
+                    f"{head_core}\t*\n")
+        for i in range(N_CORE):
+            f.write(f"c{i}\t0\ttgt\t{HEAD_END + 1}\t60\t{len(core)}M\t*\t"
+                    f"0\t0\t{core}\t*\n")
+
+
+def _polish(tmp_path, backend, monkeypatch):
+    if backend == "tpu":
+        monkeypatch.setenv("RACON_TPU_PALLAS", "0")  # XLA twin: fast on CPU
+    p = racon_tpu.create_polisher(
+        str(tmp_path / "reads.fasta"), str(tmp_path / "ovl.sam"),
+        str(tmp_path / "target.fasta"), backend=backend,
+        window_length=100, quality_threshold=10.0, error_threshold=0.9,
+        match=5, mismatch=-4, gap=-8, num_threads=1)
+    p.initialize()
+    return p.polish(True)
+
+
+def test_depth_over_cap_trim_threshold_uses_window_count(tmp_path,
+                                                         monkeypatch):
+    rng = random.Random(3)
+    truth = "".join(rng.choice("ACGT") for _ in range(100))
+    _write_dataset(tmp_path, truth)
+    assert N_HEAD + N_CORE > DEPTH_CAP  # the scenario's whole point
+
+    host = _polish(tmp_path, "cpu", monkeypatch)
+    dev = _polish(tmp_path, "tpu", monkeypatch)
+
+    assert len(host) == 1 and len(dev) == 1
+    # trimmed to the core region on both paths (head cov 103 < 105,
+    # tail cov 1) — an admitted-count threshold (100) would keep the head
+    assert host[0][1] == truth[HEAD_END:CORE_END]
+    assert dev[0][1] == host[0][1]
