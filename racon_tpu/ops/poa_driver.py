@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import sys
+from collections import deque
 from typing import List
 
 import numpy as np
@@ -23,6 +24,11 @@ from .encoding import decode, encode
 
 DEPTH_CAP = 200                    # reference: MAX_DEPTH_PER_WINDOW
 DEPTH_BUCKETS = (8, 32, DEPTH_CAP)
+
+
+def _pipeline_depth() -> int:
+    """How many packed chunks may be in flight on the device at once."""
+    return max(1, int(os.environ.get("RACON_TPU_PIPELINE_DEPTH", "2")))
 
 
 def _batch_size() -> int:
@@ -138,7 +144,15 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
             bucket = next(b for b in DEPTH_BUCKETS if depth <= b)
             buckets.setdefault(bucket, []).append((i, depth))
 
-        pending = None  # (chunk, packed, outs, cfg, pallas, kind) in flight
+        # In-flight chunks: (chunk, packed, outs, cfg, pallas, kind).
+        # JAX dispatch is async, so with depth Q the host packs/exports
+        # chunks N+1..N+Q while chunk N executes — the analogue of the
+        # reference's continuous batch fill running concurrently with
+        # kernel execution (cudapolisher.cpp:83-145). Depth >= 2 keeps the
+        # device busy across the host's pack gap even when pack time
+        # fluctuates; more mostly adds host memory (Q packed batches).
+        pending = deque()
+        q_depth = _pipeline_depth()
         # geometries (cfg, kind) whose pallas kernel already failed —
         # seeded from warm-up failures so the measured run never retries
         # a kernel the warm-up proved dead
@@ -185,16 +199,17 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
                         dead_geoms.add((cfg, bucket_kind))
                         bucket_pallas, kernel, bucket_kind = _degrade(
                             e, cfg, B, bucket_kind)
-                if pending is not None:
-                    _drain(pipeline, pending, trim, stats, fallback, B,
-                           dead_geoms)
-                pending = (chunk, packed, outs, cfg, bucket_pallas,
-                           bucket_kind)
+                pending.append((chunk, packed, outs, cfg, bucket_pallas,
+                                bucket_kind))
+                if len(pending) > q_depth:
+                    _drain(pipeline, pending.popleft(), trim, stats,
+                           fallback, B, dead_geoms)
             if progress:
                 print(f"[racon_tpu::poa] bucket depth<={depth_bucket}: "
                       f"{len(bucket_jobs)} windows", file=sys.stderr)
-        if pending is not None:
-            _drain(pipeline, pending, trim, stats, fallback, B, dead_geoms)
+        while pending:
+            _drain(pipeline, pending.popleft(), trim, stats, fallback, B,
+                   dead_geoms)
 
     for i in fallback:
         pipeline.consensus_cpu_one(i)
@@ -420,15 +435,33 @@ def _pack(chunk, cfg, pad_to=None):
         bb[bi, :L] = encode(wx.backbone)
         bbw[bi, :L] = wx.backbone_weights
         bb_len[bi] = L
-        n_layers[bi] = len(keep)
+        K = len(keep)
+        n_layers[bi] = K
+        if K == 0:
+            continue
+        # Encode the window's whole layer blob ONCE, then contiguous
+        # slice copies into flat row views — ~2x over the per-slice loop
+        # with an encode() per layer at production layer sizes (and the
+        # measured winner over a fancy-index gather/scatter, whose index
+        # arrays cost more memory traffic than the bases themselves).
+        # The reference fills batches in tight C++ under a mutex
+        # (/root/reference/src/cuda/cudapolisher.cpp:83-145).
+        enc = encode(wx.bases)
+        w_all = wx.weights
         offsets = np.concatenate([[0], np.cumsum(wx.lens)]).astype(np.int64)
-        for li, j in enumerate(keep):
-            ll = int(wx.lens[j])
-            seqs[bi, li, :ll] = encode(wx.bases[offsets[j]:offsets[j] + ll])
-            ws[bi, li, :ll] = wx.weights[offsets[j]:offsets[j] + ll]
-            lens[bi, li] = ll
-            begins[bi, li] = wx.begins[j]
-            ends[bi, li] = wx.ends[j]
+        kp = np.asarray(keep, dtype=np.int64)
+        lens_k = wx.lens[kp].astype(np.int64)
+        ML = cfg.max_len
+        sflat = seqs[bi].reshape(-1)
+        wflat = ws[bi].reshape(-1)
+        for li in range(K):
+            o = offsets[kp[li]]
+            ll = lens_k[li]
+            sflat[li * ML:li * ML + ll] = enc[o:o + ll]
+            wflat[li * ML:li * ML + ll] = w_all[o:o + ll]
+        lens[bi, :K] = lens_k
+        begins[bi, :K] = wx.begins[kp]
+        ends[bi, :K] = wx.ends[kp]
     return (bb, bbw, bb_len, n_layers, seqs, ws, lens, begins, ends)
 
 

@@ -1,13 +1,16 @@
-"""Measure the on-hardware λ device golden: run the PAF+qualities polishing
-scenario through the TPU backend (fused Pallas kernel) on the real chip and
-print the exact edit distance vs NC_001416.
+"""Measure on-hardware λ device goldens: run golden scenarios through the
+TPU backend (fused Pallas kernel) on the real chip and print the exact
+accuracy numbers to pin.
 
-The reference pins its accelerator goldens next to the CPU ones
-(/root/reference/test/racon_test.cpp:316-318, GPU 1385 vs CPU 1312); this
-script produces the number we pin the same way in tests/test_golden.py.
+The reference pins its accelerator goldens next to the CPU ones for every
+scenario (/root/reference/test/racon_test.cpp:297-507 — 10 GPU pins); this
+tool produces the numbers pinned the same way in
+racon_tpu/tools/golden_scenarios.py (asserted by tests/test_golden.py
+under RACON_TPU_HW_TESTS=1).
 
-Usage:  python racon_tpu/tools/pin_device_golden.py [scenario]
-Scenarios: paf (default) | sam | unit
+Usage:  python racon_tpu/tools/pin_device_golden.py [scenario|all]
+Scenarios: paf (default) | sam | sam_noq | paf_noq | paf_w1000 | unit
+           | kc | kf_fasta | kf_paf | all
 """
 
 import gzip
@@ -15,14 +18,18 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
 
 import racon_tpu
 from racon_tpu import native
+from racon_tpu.tools import golden_scenarios as gs
 
 # same dataset location + override knob as tests/conftest.py (not imported:
 # this tool must not inherit the test suite's CPU-mesh forcing)
 DATA = os.environ.get("RACON_TPU_TEST_DATA", "/root/reference/test/data/")
+
+ARGS = gs.ARGS  # single source: the args the asserted pins are defined by
 
 COMP = bytes.maketrans(b"ACGT", b"TGCA")
 
@@ -31,22 +38,41 @@ def revcomp(b: bytes) -> bytes:
     return b.translate(COMP)[::-1]
 
 
+def run_scenario(name: str, ref: bytes):
+    if name in gs.POLISH:
+        reads, ovl, tgt, extra = gs.POLISH[name]
+        kind = "polish"
+    else:
+        reads, ovl, tgt, extra = gs.FRAGMENT[name]
+        kind = "fragment"
+    args = dict(ARGS)
+    extra = dict(extra)
+    drop = extra.pop("drop", True)
+    args.update(extra)
+    t0 = time.time()
+    p = racon_tpu.create_polisher(DATA + reads, DATA + ovl, DATA + tgt,
+                                  backend="tpu", **args)
+    p.initialize()
+    res = p.polish(drop)
+    dt = time.time() - t0
+    if kind == "polish":
+        assert len(res) == 1, len(res)
+        ed = native.edit_distance(revcomp(res[0][1].encode()), ref)
+        return f"{name}: device_golden_ed={ed} wall={dt:.1f}s"
+    count = len(res)
+    total = sum(len(d) for _, d in res)
+    return f"{name}: device_golden=({count}, {total}) wall={dt:.1f}s"
+
+
 def main():
     scenario = sys.argv[1] if len(sys.argv) > 1 else "paf"
-    # keep in sync with tests/test_golden.py ARGS — the number this prints
-    # is only meaningful as the pin for that test's scenario
-    args = dict(window_length=500, quality_threshold=10.0,
-                error_threshold=0.3, match=5, mismatch=-4, gap=-8,
-                num_threads=1)
-    reads, ovl = "sample_reads.fastq.gz", "sample_overlaps.paf.gz"
-    if scenario == "sam":
-        ovl = "sample_overlaps.sam.gz"
-    elif scenario == "unit":
-        args.update(match=1, mismatch=-1, gap=-1)
+    known = list(gs.POLISH) + list(gs.FRAGMENT)
+    if scenario != "all" and scenario not in known:
+        sys.exit(f"unknown scenario {scenario!r}; one of {known} or 'all'")
 
     with gzip.open(DATA + "sample_reference.fasta.gz", "rb") as f:
-        ref = b"".join(line.strip() for line in f if not
-                       line.startswith(b">"))
+        ref = b"".join(line.strip() for line in f
+                       if not line.startswith(b">"))
 
     import jax
     platform = jax.devices()[0].platform
@@ -54,18 +80,12 @@ def main():
         # a CPU/interpret-mode number must never be mistaken for the
         # hardware golden (the axon tunnel silently falls back when down)
         sys.exit(f"refusing to measure: platform is {platform!r}, not tpu")
+    tier = os.environ.get("RACON_TPU_POA_KERNEL", "ls")
+    print(f"platform={platform} kernel_tier={tier}")
 
-    t0 = time.time()
-    p = racon_tpu.create_polisher(DATA + reads, DATA + ovl,
-                                  DATA + "sample_layout.fasta.gz",
-                                  backend="tpu", **args)
-    p.initialize()
-    res = p.polish(True)
-    dt = time.time() - t0
-    assert len(res) == 1, len(res)
-    ed = native.edit_distance(revcomp(res[0][1].encode()), ref)
-    print(f"platform={platform} scenario={scenario} device_golden_ed={ed} "
-          f"wall={dt:.1f}s")
+    names = known if scenario == "all" else [scenario]
+    for name in names:
+        print(run_scenario(name, ref), flush=True)
 
 
 if __name__ == "__main__":

@@ -36,13 +36,13 @@ import pytest
 
 import racon_tpu
 from racon_tpu import native
+from racon_tpu.tools import golden_scenarios as gs
 from tests.conftest import DATA, revcomp, requires_data
 
 FULL = os.environ.get("RACON_TPU_FULL_GOLDEN") == "1"
 HW = os.environ.get("RACON_TPU_HW_TESTS") == "1"
 
-ARGS = dict(window_length=500, quality_threshold=10.0, error_threshold=0.3,
-            match=5, mismatch=-4, gap=-8, num_threads=1)
+ARGS = gs.ARGS  # single source: the args the pinned numbers are defined by
 
 
 pytestmark = requires_data
@@ -56,60 +56,71 @@ def polish(seqs, ovl, tgt, backend="cpu", drop=True, **kw):
     return p.polish(drop)
 
 
+def run_scenario(name, backend="cpu"):
+    """Run one golden_scenarios entry; returns the polish result list."""
+    if name in gs.POLISH:
+        reads, ovl, tgt, extra = gs.POLISH[name]
+    else:
+        reads, ovl, tgt, extra = gs.FRAGMENT[name]
+    extra = dict(extra)
+    drop = extra.pop("drop", True)
+    return polish(reads, ovl, tgt, backend=backend, drop=drop, **extra)
+
+
 def ed_vs_reference(res, lambda_reference):
     assert len(res) == 1
     return native.edit_distance(revcomp(res[0][1].encode()), lambda_reference)
 
 
 def test_consensus_sam_with_qualities(lambda_reference):
-    res = polish("sample_reads.fastq.gz", "sample_overlaps.sam.gz",
-                 "sample_layout.fasta.gz")
-    assert ed_vs_reference(res, lambda_reference) == 1315  # reference: 1317
+    res = run_scenario("sam")
+    assert ed_vs_reference(res, lambda_reference) == \
+        gs.HOST_POLISH["sam"]  # reference: 1317
 
 
 def test_consensus_sam_without_qualities(lambda_reference):
-    res = polish("sample_reads.fasta.gz", "sample_overlaps.sam.gz",
-                 "sample_layout.fasta.gz")
-    assert ed_vs_reference(res, lambda_reference) == 1769  # reference: 1770
+    res = run_scenario("sam_noq")
+    assert ed_vs_reference(res, lambda_reference) == \
+        gs.HOST_POLISH["sam_noq"]  # reference: 1770
 
 
 def test_consensus_paf_with_qualities(lambda_reference):
-    res = polish("sample_reads.fastq.gz", "sample_overlaps.paf.gz",
-                 "sample_layout.fasta.gz")
-    assert ed_vs_reference(res, lambda_reference) == 1283  # reference: 1312
+    res = run_scenario("paf")
+    assert ed_vs_reference(res, lambda_reference) == \
+        gs.HOST_POLISH["paf"]  # reference: 1312
 
 
 @pytest.mark.skipif(not FULL, reason="slow on 1-core host; "
                     "set RACON_TPU_FULL_GOLDEN=1")
 def test_consensus_paf_without_qualities(lambda_reference):
-    res = polish("sample_reads.fasta.gz", "sample_overlaps.paf.gz",
-                 "sample_layout.fasta.gz")
-    assert ed_vs_reference(res, lambda_reference) == 1443  # reference: 1566
+    res = run_scenario("paf_noq")
+    assert ed_vs_reference(res, lambda_reference) == \
+        gs.HOST_POLISH["paf_noq"]  # reference: 1566
 
 
 @pytest.mark.skipif(not FULL, reason="slow on 1-core host; "
                     "set RACON_TPU_FULL_GOLDEN=1")
 def test_consensus_paf_larger_window(lambda_reference):
-    res = polish("sample_reads.fastq.gz", "sample_overlaps.paf.gz",
-                 "sample_layout.fasta.gz", window_length=1000)
-    assert ed_vs_reference(res, lambda_reference) == 1304  # reference: 1289
+    res = run_scenario("paf_w1000")
+    assert ed_vs_reference(res, lambda_reference) == \
+        gs.HOST_POLISH["paf_w1000"]  # reference: 1289
 
 
 @pytest.mark.skipif(not FULL, reason="slow on 1-core host; "
                     "set RACON_TPU_FULL_GOLDEN=1")
 def test_consensus_paf_unit_scores(lambda_reference):
-    res = polish("sample_reads.fastq.gz", "sample_overlaps.paf.gz",
-                 "sample_layout.fasta.gz", match=1, mismatch=-1, gap=-1)
-    assert ed_vs_reference(res, lambda_reference) == 1338  # reference: 1321
+    res = run_scenario("unit")
+    assert ed_vs_reference(res, lambda_reference) == \
+        gs.HOST_POLISH["unit"]  # reference: 1321
 
 
 @pytest.mark.skipif(not FULL, reason="slow on 1-core host; "
                     "set RACON_TPU_FULL_GOLDEN=1")
 def test_fragment_correction_kc(lambda_reference):
-    res = polish("sample_reads.fastq.gz", "sample_ava_overlaps.paf.gz",
-                 "sample_reads.fastq.gz", match=1, mismatch=-1, gap=-1)
-    assert len(res) == 40  # reference: 40
-    assert sum(len(d) for _, d in res) == 401215  # reference: 401246
+    res = run_scenario("kc")
+    count, total = gs.HOST_FRAGMENT["kc"]  # reference: 40 / 401246
+    assert len(res) == count
+    assert sum(len(d) for _, d in res) == total
 
 
 def _on_tpu():
@@ -124,29 +135,49 @@ def _on_tpu():
                     reason="slow (device path in interpret/CPU mode); set "
                     "RACON_TPU_FULL_GOLDEN=1, or RACON_TPU_HW_TESTS=1 on "
                     "a TPU machine (fast there, and asserts the exact pin)")
-def test_device_path_paf_with_qualities(lambda_reference):
-    """TPU-path accuracy (the reference pins exact accelerator numbers next
-    to the CPU ones, test/racon_test.cpp:297-318, GPU 1385 vs CPU 1312).
+@pytest.mark.parametrize("name", list(gs.POLISH) + list(gs.FRAGMENT))
+def test_device_path_golden(name, lambda_reference):
+    """TPU-path accuracy for EVERY golden scenario (the reference pins 10
+    accelerator numbers next to the CPU ones, racon_test.cpp:297-507).
 
-    On real TPU hardware the fused Pallas path is pinned EXACTLY: 1282,
-    measured on a v5e (2026-07-29, racon_tpu/tools/pin_device_golden.py) —
-    one edit from the host path's 1283 (a DP score-tie resolved differently
-    on device), better than the reference's CPU 1312 and GPU 1385. The
+    On real TPU hardware each measured pin from golden_scenarios.py is
+    asserted EXACTLY; scenarios whose pin is still None skip with a
+    pointer to the pin tool (never a silent pass). E.g. 'paf' is pinned
+    1282, measured on a v5e (2026-07-29, pin_device_golden.py) — one edit
+    from the host path's 1283 (a DP score-tie resolved differently on
+    device), better than the reference's CPU 1312 and GPU 1385. The
     hardware branch needs RACON_TPU_HW_TESTS=1 (conftest otherwise forces
-    the virtual CPU mesh). On the CPU backend (interpret mode) the same
-    kernel must land within a small band of the host golden."""
+    the virtual CPU mesh). On the CPU backend (interpret mode) only the
+    historical 'paf' scenario runs — within a small band of the host
+    golden; the other 8 would take hours in interpret mode on this box.
+    """
     if HW and not _on_tpu():
         # never let a wedged tunnel (JAX silently falls back to CPU) pass
         # the loose band off as a re-verified hardware pin
         pytest.fail("RACON_TPU_HW_TESTS=1 but the JAX platform is not tpu "
                     "— hardware pin not exercised")
-    res = polish("sample_reads.fastq.gz", "sample_overlaps.paf.gz",
-                 "sample_layout.fasta.gz", backend="tpu")
-    ed = ed_vs_reference(res, lambda_reference)
+    is_polish = name in gs.POLISH
     if _on_tpu():
-        assert ed == 1282, ed  # hardware pin; host 1283, reference GPU 1385
+        pin = (gs.DEVICE_POLISH if is_polish else gs.DEVICE_FRAGMENT)[name]
+        if pin is None:
+            pytest.skip(f"device pin for {name!r} not yet measured — run "
+                        f"racon_tpu/tools/pin_device_golden.py {name} on a "
+                        "healthy chip and record it in golden_scenarios.py")
+        res = run_scenario(name, backend="tpu")
+        if is_polish:
+            assert ed_vs_reference(res, lambda_reference) == pin
+        else:
+            count, total = pin
+            assert len(res) == count
+            assert sum(len(d) for _, d in res) == total
     else:
-        assert abs(ed - 1283) <= 15, ed  # host golden: 1283
+        if name != "paf":
+            pytest.skip("interpret-mode device golden runs only the 'paf' "
+                        "scenario (hours per scenario on a 1-core host); "
+                        "full coverage is the RACON_TPU_HW_TESTS=1 branch")
+        res = run_scenario(name, backend="tpu")
+        ed = ed_vs_reference(res, lambda_reference)
+        assert abs(ed - gs.HOST_POLISH["paf"]) <= 15, ed
 
 
 @pytest.mark.skipif(not FULL, reason="very slow on 1-core host; "
@@ -154,18 +185,16 @@ def test_device_path_paf_with_qualities(lambda_reference):
 def test_fragment_correction_kf_fasta(lambda_reference):
     """kF with FASTA reads (no qualities) — reference pins 236/1,663,982
     (test/racon_test.cpp:270-276, GPU 1,663,732)."""
-    res = polish("sample_reads.fasta.gz", "sample_ava_overlaps.paf.gz",
-                 "sample_reads.fasta.gz", fragment_correction=True,
-                 match=1, mismatch=-1, gap=-1, drop=False)
-    assert len(res) == 236  # reference: 236
-    assert sum(len(d) for _, d in res) == 1662904  # reference: 1663982
+    res = run_scenario("kf_fasta")
+    count, total = gs.HOST_FRAGMENT["kf_fasta"]  # reference: 236 / 1663982
+    assert len(res) == count
+    assert sum(len(d) for _, d in res) == total
 
 
 @pytest.mark.skipif(not FULL, reason="very slow on 1-core host; "
                     "set RACON_TPU_FULL_GOLDEN=1")
 def test_fragment_correction_kf_paf(lambda_reference):
-    res = polish("sample_reads.fastq.gz", "sample_ava_overlaps.paf.gz",
-                 "sample_reads.fastq.gz", fragment_correction=True,
-                 match=1, mismatch=-1, gap=-1, drop=False)
-    assert len(res) == 236  # reference: 236
-    assert sum(len(d) for _, d in res) == 1657837  # reference: 1658216
+    res = run_scenario("kf_paf")
+    count, total = gs.HOST_FRAGMENT["kf_paf"]  # reference: 236 / 1658216
+    assert len(res) == count
+    assert sum(len(d) for _, d in res) == total
