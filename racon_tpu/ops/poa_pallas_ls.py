@@ -103,13 +103,26 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False):
         def lanes_of(x):
             return lane_n if x.shape[-3] == NC else lane_j
 
-        def ex(val, r):
-            """val (C,G,128) at global index r (shared scalar) -> (1,G,1).
-            """
-            c = jax.lax.dynamic_slice_in_dim(val, r // 128, 1, 0)[0]
-            m = lane1 == (r % 128)
+        def _lane_extract(c, idx):
+            """(G,128) row -> (1,G,1) value at lane idx (masked sum)."""
+            m = lane1 == (idx % 128)
             return jnp.sum(jnp.where(m, c, jnp.zeros_like(c)), axis=-1,
                            keepdims=True)[None]
+
+        def exr(ref, r):
+            """ref (C,G,128) at global index r (shared scalar) -> (1,G,1).
+
+            Reads THROUGH the ref with pl.ds — dynamic_slice on a loaded
+            value does not lower to Mosaic (caught by the jax.export
+            cross-lowering check; interpret mode accepts it silently).
+            One (1,G,128) VMEM load + a lane mask, not an O(N) masked
+            reduction over every chunk."""
+            return _lane_extract(ref[pl.ds(r // 128, 1)][0], r)
+
+        def exs(ref, slot, j):
+            """(2,JC,G,128) double-buffer ref at (slot, global j)."""
+            return _lane_extract(
+                ref[pl.ds(slot, 1), pl.ds(j // 128, 1)][0, 0], j)
 
         def ex_v(val, rv):
             """val (C,G,128) at per-window indices rv (1,G,1)."""
@@ -249,15 +262,11 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             r_end = jnp.max(jnp.where(lact, r_hi, 0))
 
             seqv = seq_scr[pl.ds(slot, 1)][0]          # (JC, G, 128)
-            wv = w_scr[pl.ds(slot, 1)][0]
             seqm1 = shift_right(seqv, 255)             # lane j: seq[j-1]
             rk_dmax[...] = jnp.max(rk_delta[...], axis=0)
 
             # layer-invariant snapshots (the graph does not change during
             # DP + traceback; Mosaic keeps these as VMEM-backed values)
-            base_v = rk_base[...]
-            key_v = rk_key[...]
-            cnt_v = rk_cnt[...]
             dmax_v = rk_dmax[...]
             delta_v = [rk_delta[e] for e in range(E)]
             H0v = H0[...]
@@ -280,11 +289,11 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False):
             # ---- DP over ranks in lock-step -----------------------------
             def dp_body(r, _):
                 act = lact & (r >= r_lo) & (r < r_hi)
-                dmax_r = jnp.minimum(jnp.max(ex(dmax_v, r)), DMAX)
+                dmax_r = jnp.minimum(jnp.max(exr(rk_dmax, r)), DMAX)
                 dmax_r = jnp.minimum(dmax_r, r)
                 ds = []
                 for e in range(E):
-                    d_e = ex(delta_v[e], r)
+                    d_e = exr(rk_delta.at[e], r)
                     valid = ((d_e > 0) & (d_e <= DMAX) &
                              (r - d_e >= r_lo) & act)
                     ds.append(jnp.where(valid, d_e, 0))
@@ -303,7 +312,7 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 P = jax.lax.fori_loop(1, dmax_r + 1, delta_scan, P0)
                 P = jnp.where(any_valid, P, H0v)
 
-                ub = ex(base_v, r)
+                ub = exr(rk_base, r)
                 scvec = jnp.where(seqm1 == ub, M, X)
                 diag = shift_right(P, NEG) + scvec
                 up = P + GP
@@ -401,17 +410,17 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 cur, jcur, nk, run, done, failed = c
                 here = ~done & (cur == r)
                 row = ring_row(r)
-                ub = ex(base_v, r)
+                ub = exr(rk_base, r)
                 scv = jnp.where(seqm1 == ub, M, X)
                 ds = []
                 for e in range(E):
-                    d_e = ex(delta_v[e], r)
+                    d_e = exr(rk_delta.at[e], r)
                     valid = (d_e > 0) & (d_e <= DMAX) & (r - d_e >= r_lo)
                     ds.append(jnp.where(valid, d_e, 0))
                 any_v = ds[0] > 0
                 for e in range(1, E):
                     any_v = any_v | (ds[e] > 0)
-                dmax_r = jnp.minimum(jnp.max(ex(dmax_v, r)), DMAX)
+                dmax_r = jnp.minimum(jnp.max(exr(rk_dmax, r)), DMAX)
                 dmax_r = jnp.minimum(dmax_r, r)
 
                 # min over (slot, delta) packed as slot*256+delta: the
@@ -465,7 +474,7 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 wu_virt = ex_v(jnp.where(wup == WNONE, 1, 0), j_stop) == 1
                 take_up = act & ~take_diag
 
-                kr = ex(key_v, r)
+                kr = exr(rk_key, r)
                 nk = jnp.where(take_diag, kr, nk)
                 mlane = (jj == j_stop - 1) & take_diag
                 runrem[...] = jnp.where(mlane, 0, runrem[...])
@@ -520,16 +529,14 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False):
 
             # ---- graph update (parity: rt_poa.cpp add_alignment) --------
             maxL = jnp.max(jnp.where(lact & (failed == 0), Ln, 0))
-            runrem_v = runrem[...]
-            nkey_v = nkey[...]
 
             def upd_body(j, c):
                 n, failed, prev_r, prev_key, prev_w = c
                 act = lact & (j < Ln) & (failed == 0)
-                b = ex(seqv, j)
-                wj = ex(wv, j)
-                run_j = ex(runrem_v, j)
-                nk_j = ex(nkey_v, j)
+                b = exs(seq_scr, slot, j)
+                wj = exs(w_scr, slot, j)
+                run_j = exr(runrem, j)
+                nk_j = exr(nkey, j)
                 is_match = (run_j == 0) & act
                 k0 = nk_j
 
@@ -670,20 +677,19 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False):
         score[...] = jnp.zeros((NC, G, 128), jnp.int32)
         spred[...] = jnp.full((NC, G, 128), -1, jnp.int32)
         n_max = jnp.max(n)
-        cnt_f_v = rk_cnt[...]
         delta_f = [rk_delta[e] for e in range(E)]
         ew_f = [rk_ew[e] for e in range(E)]
 
         def score_body(r, c):
             best_r, best_s = c
             act = r < n
-            cnt_r = ex(cnt_f_v, r)
+            cnt_r = exr(rk_cnt, r)
             bw = jnp.full((1, G, 1), NEG, jnp.int32)
             bs = jnp.full((1, G, 1), NEG, jnp.int32)
             bp = jnp.full((1, G, 1), -1, jnp.int32)
             for e in range(E):
-                d_e = ex(delta_f[e], r)
-                w_e = ex(ew_f[e], r)
+                d_e = exr(rk_delta.at[e], r)
+                w_e = exr(rk_ew.at[e], r)
                 valid = (d_e > 0) & (e < cnt_r)
                 s_e = ex_v(score[...], jnp.clip(r - d_e, 0, N - 1))
                 better = valid & ((w_e > bw) | ((w_e == bw) & (s_e > bs)))
