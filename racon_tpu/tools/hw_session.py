@@ -1,0 +1,119 @@
+"""One-command TPU hardware session: run the full measurement priority
+list the moment the tunnel is healthy, every step in a bounded subprocess.
+
+The axon tunnel wedges for hours and can die mid-session (round 2: it
+wedged between the bench and the golden re-pin), so the priority order
+front-loads the headline evidence and every step is independently
+time-boxed and durably logged — a step that hangs is killed and the
+session moves on. Priorities:
+
+  1. probe        — device reachable + tiny matmul (2 min bound)
+  2. bench        — python bench.py at the default 0.5 Mbp; bench.py
+                    itself probes pallas tiers, warms geometries, and
+                    appends to docs/device_bench_log.jsonl (45 min)
+  3. bench5       — RACON_TPU_BENCH_MBP=5 scale run (90 min)
+  4. pins         — pin_device_golden.py all: every golden scenario's
+                    device number in one pass (60 min)
+  5. aligner      — Hirschberg vs host phase-1 measurement via
+                    RACON_TPU_DEVICE_ALIGNER=hirschberg bench at 0.5 Mbp
+                    (45 min; decides align_driver's default)
+
+Usage:
+    python racon_tpu/tools/hw_session.py           # all steps in order
+    python racon_tpu/tools/hw_session.py bench pins  # a subset
+
+Output: stdout + one JSON line per step appended to
+docs/hw_session_log.jsonl (durable, committed — the evidence trail
+survives a tunnel death mid-session).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+LOG = os.path.join(HERE, "docs", "hw_session_log.jsonl")
+
+PROBE = ("import jax, jax.numpy as jnp; "
+         "x = jnp.ones((256, 256)); print(float((x @ x).sum())); "
+         "print('devices:', jax.devices())")
+
+STEPS = [
+    ("probe", [sys.executable, "-c", PROBE], 120, {}),
+    ("bench", [sys.executable, "bench.py"], 2700, {}),
+    ("bench5", [sys.executable, "bench.py"], 5400,
+     {"RACON_TPU_BENCH_MBP": "5"}),
+    ("pins", [sys.executable, "racon_tpu/tools/pin_device_golden.py",
+              "all"], 3600, {}),
+    ("aligner", [sys.executable, "bench.py"], 2700,
+     {"RACON_TPU_DEVICE_ALIGNER": "hirschberg"}),
+]
+
+
+def log_step(entry):
+    entry = dict(entry, utc=time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime()))
+    try:
+        with open(LOG, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError as e:
+        print(f"[hw_session] WARNING: cannot append {LOG}: {e}",
+              file=sys.stderr)
+
+
+def run_step(name, cmd, bound_s, extra_env):
+    print(f"[hw_session] === {name} (bound {bound_s}s) ===", flush=True)
+    env = dict(os.environ, **extra_env)
+    t0 = time.time()
+    # start_new_session: a timeout must kill the step's WHOLE process
+    # group — bench.py runs its own probe subprocesses, and an orphaned
+    # probe wedged on the tunnel would hold the device and poison every
+    # later step
+    p = subprocess.Popen(cmd, cwd=HERE, env=env, text=True,
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT,
+                         start_new_session=True)
+    try:
+        out, _ = p.communicate(timeout=bound_s)
+        ok = p.returncode == 0
+        tail = (out or "")[-2000:]
+    except subprocess.TimeoutExpired:
+        ok = False
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        # keep the partial output: 44 minutes of measured results before a
+        # tunnel death ARE the evidence this tool exists to preserve
+        out, _ = p.communicate()
+        tail = ((out or "")[-2000:] + f"\nTIMEOUT after {bound_s}s")
+    dt = time.time() - t0
+    print(tail, flush=True)
+    print(f"[hw_session] {name}: {'OK' if ok else 'FAILED'} in {dt:.0f}s",
+          flush=True)
+    log_step({"step": name, "ok": ok, "wall_s": round(dt, 1),
+              "env": extra_env, "tail": tail[-600:]})
+    return ok
+
+
+def main():
+    wanted = sys.argv[1:] or [n for n, *_ in STEPS]
+    unknown = set(wanted) - {n for n, *_ in STEPS}
+    if unknown:
+        sys.exit(f"unknown steps {sorted(unknown)}; "
+                 f"available: {[n for n, *_ in STEPS]}")
+    for name, cmd, bound, env in STEPS:
+        if name not in wanted:
+            continue
+        ok = run_step(name, cmd, bound, env)
+        if name == "probe" and not ok:
+            sys.exit("[hw_session] tunnel not healthy; aborting (nothing "
+                     "else can succeed)")
+
+
+if __name__ == "__main__":
+    main()

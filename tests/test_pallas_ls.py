@@ -134,6 +134,39 @@ def test_lockstep_matches_host_and_jax():
                                       err_msg=f"window {b} coverage")
 
 
+def test_lockstep_ring_spill_at_large_geometry():
+    """Windows of 420+ ranks force the 128-row H ring to wrap multiple
+    times: DP chunks are DMA'd to the HBM spill buffer under compute and
+    streamed back block-descending during traceback (poa_pallas_ls.py
+    flush_chunk/tb_load). The small-geometry tests never leave the ring;
+    this one crosses ~7 traceback blocks and must still match both the
+    XLA twin and the host oracle exactly."""
+    rng = random.Random(21)
+    big = poa.PoaConfig(max_nodes=768, max_len=640, max_backbone=512,
+                        max_edges=12, depth=4, match=5, mismatch=-4,
+                        gap=-8)
+    B = 8
+    a = _alloc(B, big)
+    cases = {}
+    for b in range(B):
+        truth = bytes(rng.choice(b"ACGT") for _ in range(420 + 10 * b))
+        backbone = mutate(truth, 0.1, rng)
+        layers = [mutate(truth, 0.1, rng) for _ in range(3)]
+        _set_window(a, b, backbone, layers)
+        cases[b] = (backbone, layers)
+
+    (cb, cc, cl, fl, nn), (jb, jc, jl, jf, jn) = _run_both(a, big, B)
+
+    assert not fl.any() and not jf.any()
+    for b, (backbone, layers) in cases.items():
+        host, _ = native.window_consensus(
+            backbone, [bytes(l) for l in layers], trim=False)
+        ls = decode(cb[b, :cl[b, 0]])
+        jx = decode(jb[b, :jl[b]])
+        assert ls == jx == host, f"window {b}"
+        assert int(nn[b, 0]) == int(jn[b]), f"window {b} node count"
+
+
 def test_lockstep_dmax_cap_fails_window_to_host():
     """A window whose graph grows an in-subgraph edge with rank distance
     beyond DMAX must raise its failed flag (-> driver host fallback), and
