@@ -117,7 +117,7 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
     window_length = 0
 
     # Metadata pass: geometry + depth buckets, no layer bytes touched.
-    jobs = []          # (window_idx, estimated depth)
+    jobs = []          # (window_idx, estimated depth, backbone len)
     for i in range(n):
         n_seqs, bb_len, _rank, _is_tgs, _bytes, _tid = pipeline.window_info(i)
         window_length = max(window_length, bb_len)
@@ -129,7 +129,7 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
             pipeline.set_consensus(i, wx.backbone.tobytes(), False)
             stats["backbone"] += 1
             continue
-        jobs.append((i, min(k, DEPTH_CAP)))
+        jobs.append((i, min(k, DEPTH_CAP), bb_len))
 
     if jobs:
         n_dev = _n_devices()
@@ -140,9 +140,9 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
         # time (oversized/empty) only shrink a window's true depth, so a
         # window always fits the bucket its estimate chose.
         buckets = {}
-        for i, depth in jobs:
+        for i, depth, bb in jobs:
             bucket = next(b for b in DEPTH_BUCKETS if depth <= b)
-            buckets.setdefault(bucket, []).append((i, depth))
+            buckets.setdefault(bucket, []).append((i, depth, bb))
 
         # In-flight chunks: (chunk, packed, outs, cfg, pallas, kind).
         # JAX dispatch is async, so with depth Q the host packs/exports
@@ -172,15 +172,18 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
             # persistent compilation caches already amortize.)
             kernel = _build_kernel(cfg, B, bucket_pallas, bucket_kind)
             # Sequential loops run lock-step across the batch, so keep
-            # batches depth-homogeneous.
-            bucket_jobs.sort(key=lambda job: job[1])
+            # batches depth-homogeneous — and length-homogeneous within
+            # equal depth: a lockstep program's DP range is the union
+            # over its 8 windows, so mixing a short window into a long
+            # group bills it the long group's ranks.
+            bucket_jobs.sort(key=lambda job: (job[1], job[2]))
             for off in range(0, len(bucket_jobs), B):
                 while bucket_pallas and (cfg, bucket_kind) in dead_geoms:
                     # an earlier chunk (or the warm-up) proved this tier
                     # dead for this geometry: step down before dispatching
                     bucket_pallas, kernel, bucket_kind = _step_down(
                         cfg, B, bucket_kind)
-                idxs = [i for i, _ in bucket_jobs[off:off + B]]
+                idxs = [i for i, _, _ in bucket_jobs[off:off + B]]
                 # Always pad to B: a dataset-size-dependent final-chunk
                 # shape would force an extra jit compile per distinct
                 # remainder (padded windows are 1-base/0-layer — free).

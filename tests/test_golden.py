@@ -136,7 +136,7 @@ def _on_tpu():
                     "RACON_TPU_FULL_GOLDEN=1, or RACON_TPU_HW_TESTS=1 on "
                     "a TPU machine (fast there, and asserts the exact pin)")
 @pytest.mark.parametrize("name", list(gs.POLISH) + list(gs.FRAGMENT))
-def test_device_path_golden(name, lambda_reference):
+def test_device_path_golden(name, lambda_reference, monkeypatch):
     """TPU-path accuracy for EVERY golden scenario (the reference pins 10
     accelerator numbers next to the CPU ones, racon_test.cpp:297-507).
 
@@ -175,6 +175,10 @@ def test_device_path_golden(name, lambda_reference):
             pytest.skip("interpret-mode device golden runs only the 'paf' "
                         "scenario (hours per scenario on a 1-core host); "
                         "full coverage is the RACON_TPU_HW_TESTS=1 branch")
+        # v2 tier: interpret-mode ls at λ scale is far slower, and ls
+        # correctness is pinned by its own differential tests
+        # (tests/test_pallas_ls.py); this branch checks the driver + band
+        monkeypatch.setenv("RACON_TPU_POA_KERNEL", "v2")
         res = run_scenario(name, backend="tpu")
         ed = ed_vs_reference(res, lambda_reference)
         assert abs(ed - gs.HOST_POLISH["paf"]) <= 15, ed

@@ -134,6 +134,55 @@ def test_lockstep_matches_host_and_jax():
                                       err_msg=f"window {b} coverage")
 
 
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_lockstep_differential_fuzz(seed):
+    """Seeded random windows — lengths, depths, mutation rates, partial
+    spans, per-base layer weights AND backbone weights (the product
+    exports PHRED-33 backbone weights, dummy '!' = 0 when the target has
+    no quality; rt_capi.cpp rt_pipeline_window_export) — asserted
+    lockstep == XLA twin == host oracle."""
+    rng = random.Random(seed)
+    B = 8
+    a = _alloc(B, CFG)
+    cases = {}
+    for b in range(B):
+        L = rng.randrange(40, 110)
+        truth = bytes(rng.choice(b"ACGT") for _ in range(L))
+        backbone = mutate(truth, rng.uniform(0.02, 0.12), rng)
+        nl = rng.randrange(2, CFG.depth + 1)
+        layers = [mutate(truth, rng.uniform(0.02, 0.12), rng)
+                  for _ in range(nl)]
+        bq = np.array([rng.randrange(0, 60) for _ in range(len(backbone))],
+                      np.int32)
+        w = [np.array([rng.randrange(1, 60) for _ in range(len(l))],
+                      np.int32) for l in layers]
+        begins = [0] * nl
+        ends = [len(backbone) - 1] * nl
+        if nl >= 3:  # one partial-span layer per window when depth allows
+            begins[nl - 1] = len(backbone) // 3
+            ends[nl - 1] = 2 * len(backbone) // 3
+            layers[nl - 1] = layers[nl - 1][:max(
+                1, len(layers[nl - 1]) // 3)]
+            w[nl - 1] = w[nl - 1][:len(layers[nl - 1])]
+        _set_window(a, b, backbone, layers, weights=w, begins=begins,
+                    ends=ends)
+        a["bbw"][b, :len(backbone)] = bq
+        cases[b] = (backbone, layers, w, bq, begins, ends)
+
+    (cb, cc, cl, fl, nn), (jb, jc, jl, jf, jn) = _run_both(a, CFG, B)
+
+    assert not fl.any() and not jf.any()
+    for b, (backbone, layers, w, bq, begins, ends) in cases.items():
+        quals = [bytes((x + 33).astype(np.uint8)) for x in w]
+        host, _ = native.window_consensus(
+            backbone, [bytes(l) for l in layers],
+            backbone_qual=bytes((bq + 33).astype(np.uint8)),
+            quals=quals, begins=begins, ends=ends, trim=False)
+        ls = decode(cb[b, :cl[b, 0]])
+        jx = decode(jb[b, :jl[b]])
+        assert ls == jx == host, f"seed {seed} window {b}"
+
+
 def test_lockstep_ring_spill_at_large_geometry():
     """Windows of 420+ ranks force the 128-row H ring to wrap multiple
     times: DP chunks are DMA'd to the HBM spill buffer under compute and
