@@ -64,6 +64,18 @@ def main(argv=None) -> int:
 
     from .native import NativeError
 
+    if args.tpu:
+        # Validate device-path env config up front (same contract as the
+        # file-extension checks: single-line error, exit 1) — a broad
+        # ValueError catch around the whole run would also swallow real
+        # bugs' tracebacks.
+        from .ops.poa_driver import _kernel_kind
+        try:
+            _kernel_kind()
+        except ValueError as e:
+            print(e, file=sys.stderr)
+            return 1
+
     try:
         polisher = create_polisher(
             args.sequences, args.overlaps, args.targets,

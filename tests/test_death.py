@@ -7,17 +7,16 @@ import sys
 
 from tests.conftest import DATA, requires_data
 
-BIN = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "racon_tpu", "native", "build", "racon_tpu")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BIN = os.path.join(ROOT, "racon_tpu", "native", "build", "racon_tpu")
 
-
-pytestmark = requires_data
 
 def run_bin(*args):
     return subprocess.run([BIN, *args], capture_output=True, text=True,
                           timeout=120)
 
 
+@requires_data
 def test_window_length_error():
     r = run_bin("-w", "0", DATA + "sample_reads.fastq.gz",
                 DATA + "sample_overlaps.paf.gz",
@@ -33,12 +32,14 @@ def test_sequences_extension_error():
     assert ".fasta" in r.stderr
 
 
+@requires_data
 def test_overlaps_extension_error():
     r = run_bin(DATA + "sample_reads.fastq.gz", "o.bed", "t.fa")
     assert r.returncode == 1
     assert ".mhap" in r.stderr
 
 
+@requires_data
 def test_target_extension_error():
     r = run_bin(DATA + "sample_reads.fastq.gz",
                 DATA + "sample_overlaps.paf.gz", "t.bed")
@@ -52,8 +53,40 @@ def test_missing_inputs():
     assert "missing input" in r.stderr
 
 
+@requires_data
 def test_missing_file():
     r = run_bin(DATA + "sample_reads.fastq.gz",
                 DATA + "sample_overlaps.paf.gz", "/nonexistent/x.fasta")
     assert r.returncode == 1
     assert "unable to open" in r.stderr
+
+
+def test_bad_kernel_kind_env_clean_error(tmp_path):
+    """An invalid RACON_TPU_POA_KERNEL must surface as the reference-style
+    single-line error + exit 1 from the Python CLI, not a traceback.
+    Self-contained (builds its own inputs): runs even without the
+    reference λ fixtures."""
+    target = "ACGT" * 30
+    with open(tmp_path / "t.fasta", "w") as f:
+        f.write(f">t\n{target}\n")
+    with open(tmp_path / "r.fasta", "w") as f:
+        for i in range(3):
+            f.write(f">r{i}\n{target}\n")
+    with open(tmp_path / "o.sam", "w") as f:
+        f.write("@HD\tVN:1.6\n")
+        for i in range(3):
+            f.write(f"r{i}\t0\tt\t1\t60\t{len(target)}M\t*\t0\t0\t{target}"
+                    f"\t*\n")
+    code = (
+        "import sys; sys.path.insert(0, %r); "
+        "from __graft_entry__ import _force_cpu; _force_cpu(1); "
+        "from racon_tpu.cli import main; "
+        "sys.exit(main(['--tpu', %r, %r, %r]))"
+    ) % (ROOT, str(tmp_path / "r.fasta"), str(tmp_path / "o.sam"),
+         str(tmp_path / "t.fasta"))
+    r = subprocess.run([sys.executable, "-c", code],
+                       env=dict(os.environ, RACON_TPU_POA_KERNEL="bogus"),
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 1
+    assert "RACON_TPU_POA_KERNEL" in r.stderr
+    assert "Traceback" not in r.stderr

@@ -37,11 +37,15 @@ def _poa_args(cfg, B, rng):
             bb.astype(np.int32), bbw, seqs.astype(np.int32), ws)
 
 
-@pytest.mark.parametrize("window_length", [500])
+@pytest.mark.parametrize("window_length", [100, 500, 1000])
 def test_lockstep_poa_kernel_lowers_to_tpu(window_length):
+    """All production geometries: w=100 (small-window datasets), w=500
+    (default), w=1000 (the paf_w1000 golden scenario). The VMEM-fit model
+    must agree — a geometry _fits_vmem approves has to actually lower."""
     from racon_tpu.ops.poa_pallas_ls import build_lockstep_poa_kernel
 
     cfg = poa_driver.make_config(window_length, 8, 5, -4, -8)
+    assert poa_driver._fits_vmem(cfg, "ls"), "fit model rejects geometry"
     fn = build_lockstep_poa_kernel(cfg, interpret=False)(8)
     exp = _export_tpu(fn, _poa_args(cfg, 8, np.random.default_rng(0)))
     assert len(exp.mlir_module_serialized) > 0
