@@ -80,9 +80,19 @@ def observed_window_length(draft_path: str, w: int) -> int:
     return max(best, min(cur, w))
 
 
+def _forced_device() -> bool:
+    """RACON_TPU_BENCH_FORCE_DEVICE=1: treat the current backend as the
+    device — a CPU-backend dry run of the exact healthy-path flow (probe,
+    warm-up, measure, log). Entries logged under the override are marked
+    forced and never cited as device evidence."""
+    return os.environ.get("RACON_TPU_BENCH_FORCE_DEVICE") == "1"
+
+
 def device_healthy(timeout_s: int = 120) -> bool:
     """The axon TPU tunnel can wedge (device ops then hang forever); probe
     it in a subprocess so a dead tunnel can't hang the benchmark."""
+    if _forced_device():
+        return True
     probe = ("import jax, jax.numpy as jnp; "
              "x = jnp.ones((128, 128)); print(float((x @ x).sum()))")
     try:
@@ -108,7 +118,11 @@ def pallas_compiles(timeout_s: int = 900):
     requested = _kernel_kind()  # validates RACON_TPU_POA_KERNEL up front
     kinds = ["ls", "v2"] if requested == "ls" else ["v2"]
     for kind in kinds:
-        probe = (
+        force = ("import sys; sys.path.insert(0, %r)\n"
+                 "from __graft_entry__ import _force_cpu; _force_cpu(1)\n"
+                 % os.path.dirname(os.path.abspath(__file__))
+                 if _forced_device() else "")
+        probe = force + (
             "import numpy as np, jax, sys\n"
             "sys.path.insert(0, %r)\n"
             "from racon_tpu.ops import poa, poa_driver\n"
@@ -153,7 +167,12 @@ def log_device_measurement(entry: dict) -> None:
     try:
         entry = dict(entry, utc=time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                               time.gmtime()))
-        with open(LOG_PATH, "a") as f:
+        path = LOG_PATH
+        if _forced_device():
+            # dry runs never touch the committed device-evidence log
+            entry["forced"] = True
+            path = LOG_PATH + ".dryrun"
+        with open(path, "a") as f:
             f.write(json.dumps(entry) + "\n")
     except OSError as e:
         # An installed/read-only layout must not silently drop the one
@@ -163,12 +182,23 @@ def log_device_measurement(entry: dict) -> None:
 
 
 def last_device_measurement():
+    """Latest REAL device entry (forced dry-run entries never count;
+    a malformed hand-edited line skips, it does not hide the rest)."""
+    entries = []
     try:
         with open(LOG_PATH) as f:
-            lines = [l for l in f if l.strip()]
-        return json.loads(lines[-1]) if lines else None
-    except (OSError, ValueError):
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                if not e.get("forced"):
+                    entries.append(e)
+    except OSError:
         return None
+    return entries[-1] if entries else None
 
 
 def run(backend: str, paths):
@@ -185,6 +215,13 @@ def run(backend: str, paths):
 
 
 def main():
+    if _forced_device():
+        # dry-run mode: force the CPU backend in THIS process too — with
+        # the health probe bypassed, an ambient wedged-TPU backend would
+        # otherwise hang the warm-up/measured run unbounded, the exact
+        # failure device_healthy() exists to prevent
+        from __graft_entry__ import _force_cpu
+        _force_cpu(1)
     paths = dataset()
 
     degraded = not device_healthy()
@@ -249,6 +286,10 @@ def main():
     mbps_cpu = bp_cpu / dt_cpu / 1e6
     kernel_tag = (f" [pallas {tier}]" if pallas_ok
                   else " [XLA kernel: pallas compile failed]")
+    if _forced_device():
+        # the one-line JSON is the bench's documented output: a CPU dry
+        # run must be unmistakable there too, not only in the sidecar log
+        kernel_tag += " [FORCED DRY-RUN: not device evidence]"
     log_device_measurement({
         "mbp": MBP, "input": INPUT, "value": round(mbps_tpu, 4),
         "vs_baseline": round(mbps_tpu / mbps_cpu, 3),
