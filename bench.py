@@ -182,8 +182,10 @@ def log_device_measurement(entry: dict) -> None:
 
 
 def last_device_measurement():
-    """Latest REAL device entry (forced dry-run entries never count;
-    a malformed hand-edited line skips, it does not hide the rest)."""
+    """Latest REAL device THROUGHPUT entry — forced dry-run entries and
+    accuracy-only entries (golden re-pins, which carry no "value") never
+    count; a malformed hand-edited line skips, it does not hide the
+    rest."""
     entries = []
     try:
         with open(LOG_PATH) as f:
@@ -194,7 +196,7 @@ def last_device_measurement():
                     e = json.loads(line)
                 except ValueError:
                     continue
-                if not e.get("forced"):
+                if not e.get("forced") and "value" in e:
                     entries.append(e)
     except OSError:
         return None
@@ -305,6 +307,50 @@ def main():
     }))
     print(f"[bench] tpu: {bp_tpu} bp in {dt_tpu:.1f}s | "
           f"cpu: {bp_cpu} bp in {dt_cpu:.1f}s", file=sys.stderr)
+
+    _opportunistic_golden(tier)
+
+
+def _opportunistic_golden(tier, timeout_s: int = 900):
+    """Healthy chip in hand: also re-measure the λ device golden, bounded.
+
+    Healthy tunnel windows are scarce and every driver-run bench is a
+    chance at accuracy evidence — the measurement rides the same session
+    and lands in the durable log itself. Runs AFTER the bench numbers are
+    logged and printed so a late tunnel wedge cannot cost the headline
+    result; the subprocess bound means it cannot hang the bench either.
+    Skipped in forced dry-run mode (λ interpret on CPU takes hours) and
+    when the reference fixtures are absent."""
+    if _forced_device():
+        return
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "racon_tpu", "tools", "pin_device_golden.py")
+    data = os.environ.get("RACON_TPU_TEST_DATA",
+                          "/root/reference/test/data/")
+    if not os.path.isdir(data):
+        return
+    try:
+        r = subprocess.run([sys.executable, tool, "paf"],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"[bench] golden re-pin exceeded {timeout_s}s; skipped",
+              file=sys.stderr)
+        return
+    # stdout only — stderr carries routine JAX/runtime warnings that
+    # would otherwise be recorded as the "result"
+    result = [l for l in r.stdout.strip().splitlines()
+              if "device_golden" in l]
+    if r.returncode == 0 and result:
+        print(f"[bench] golden re-pin: {result[-1]}", file=sys.stderr)
+        # record the kernel tier the golden actually ran on: if the
+        # pallas probe failed, this number is the XLA tier's accuracy,
+        # not the fused kernel's
+        log_device_measurement({"golden_paf": result[-1][-200:],
+                                "kernel": tier or "xla"})
+    else:
+        print("[bench] golden re-pin failed: "
+              f"{(r.stderr or r.stdout)[-300:]}", file=sys.stderr)
 
 
 if __name__ == "__main__":
