@@ -9,12 +9,17 @@ session moves on. Priorities:
 
   1. probe        — device reachable + tiny matmul (2 min bound)
   2. bench        — python bench.py at the default 0.5 Mbp; bench.py
-                    itself probes pallas tiers, warms geometries, and
-                    appends to docs/device_bench_log.jsonl (45 min)
-  3. bench5       — RACON_TPU_BENCH_MBP=5 scale run (90 min)
-  4. pins         — pin_device_golden.py all: every golden scenario's
+                    itself probes pallas tiers, warms geometries, appends
+                    to docs/device_bench_log.jsonl, and re-pins the λ
+                    golden (45 min)
+  3. bench_sam    — SAM input (no alignment phase): isolates the
+                    consensus kernel, ls tier (45 min)
+  4. bench_sam_v2 — same with RACON_TPU_POA_KERNEL=v2: the on-chip
+                    ls-vs-v2 tier decision (45 min)
+  5. bench5       — RACON_TPU_BENCH_MBP=5 scale run (90 min)
+  6. pins         — pin_device_golden.py all: every golden scenario's
                     device number in one pass (60 min)
-  5. aligner      — Hirschberg vs host phase-1 measurement via
+  7. aligner      — Hirschberg vs host phase-1 measurement via
                     RACON_TPU_DEVICE_ALIGNER=hirschberg bench at 0.5 Mbp
                     (45 min; decides align_driver's default)
 
@@ -45,6 +50,12 @@ PROBE = ("import jax, jax.numpy as jnp; "
 STEPS = [
     ("probe", [sys.executable, "-c", PROBE], 120, {}),
     ("bench", [sys.executable, "bench.py"], 2700, {}),
+    # SAM input skips the alignment phase: kernel-vs-kernel consensus
+    # comparison, ls tier then v2 — the decisive on-chip tier decision
+    ("bench_sam", [sys.executable, "bench.py"], 2700,
+     {"RACON_TPU_BENCH_INPUT": "sam"}),
+    ("bench_sam_v2", [sys.executable, "bench.py"], 2700,
+     {"RACON_TPU_BENCH_INPUT": "sam", "RACON_TPU_POA_KERNEL": "v2"}),
     ("bench5", [sys.executable, "bench.py"], 5400,
      {"RACON_TPU_BENCH_MBP": "5"}),
     ("pins", [sys.executable, "racon_tpu/tools/pin_device_golden.py",
