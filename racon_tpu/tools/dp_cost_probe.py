@@ -21,9 +21,17 @@ mode, and times each:
   mode 8: mode 0 on PAIRED rows (2, 8, JW): two independent DP chains per
           iteration in double-width ops — tests pipeline ILP from wider
           vregs (per_node accounts for the 2x rows)
+  mode 9: the v3 LANE-LOCKSTEP row shape (poa_pallas_ls.py): (JC, 8, 128)
+          rows — window g in sublane g — with lane-radix-4 + chunk-prefix
+          cummax and a 128-row VMEM ring write; 8 windows per iteration
+          (per_node accounts for the 8x)
+  mode 10: mode 9 + a depth-4 delta scan (4 ring-row loads, masked max)
+          and 12 exr-style (1,8,128) graph-row loads per rank — the
+          ls dp_body's per-rank load traffic
 
-mode 4 approximates the full dp_body. The deltas between modes say which
-component to attack next; per-node microseconds are printed for each.
+mode 4 approximates the full v2 dp_body; mode 10 approximates the ls
+dp_body. The deltas between modes say which component to attack next;
+per-node microseconds are printed for each.
 
 Usage: python racon_tpu/tools/dp_cost_probe.py [R] [B] [reps]
 """
@@ -52,9 +60,12 @@ def build(mode: int, R: int, B: int, interpret: bool):
     NW = 256
     E = 12
     G = -8
+    JC = 4       # lane chunks per lockstep row (modes 9/10)
+    RING = 128   # lockstep H ring rows (modes 9/10)
+    GSLOTS = 16  # lockstep graph-row slots (mode 10 dynamic loads)
 
     def kernel(seed_ref, out_ref, H, order, base, key, in_cnt, in_src,
-               has_out):
+               has_out, gls):
         jlane = jax.lax.broadcasted_iota(jnp.int32, (8, JW), 1)
         jsub = jax.lax.broadcasted_iota(jnp.int32, (8, JW), 0)
         jj = jsub * JW + jlane
@@ -201,6 +212,88 @@ def build(mode: int, R: int, B: int, interpret: bool):
             out_ref[0, 0, 0] = H[pl.ds(R, 1)][0][0, 0, 0]
             return
 
+        if mode in (9, 10):
+            # v3 lane-lockstep row shape: (JC, 8, 128), window g in
+            # sublane g; ring of RING H rows; lane-radix-4 + chunk-prefix
+            # cummax (no cross-sublane carries — windows are independent)
+            llane = jax.lax.broadcasted_iota(jnp.int32, (JC, 8, 128), 2)
+            lchunk = jax.lax.broadcasted_iota(jnp.int32, (JC, 8, 128), 0)
+            ljj = lchunk * 128 + llane
+            lg = ljj * G
+            # the delta scan reads ring rows before the DP has written
+            # them (r < RING): every slot must hold defined, seed-derived
+            # data, or uninitialized VMEM poisons the chain on real TPU
+            # (interpret mode zero-fills and would hide it)
+            ring_i = jax.lax.broadcasted_iota(
+                jnp.int32, (RING, JC, 8, 128), 0)
+            H[:] = lg[None] + seed_ref[0, 0, 0] - ring_i
+
+            def shiftr_ls(x, fill):
+                ln = pltpu.roll(x, 1, 2)
+                carry = pltpu.roll(ln, 1, 0)
+                y = jnp.where(llane == 0, carry, ln)
+                return jnp.where(ljj == 0, fill, y)
+
+            def cummax_ls(x):
+                w = 1
+                while w < 128:
+                    shs = [jnp.where(llane >= k * w,
+                                     pltpu.roll(x, k * w, 2), NEG)
+                           for k in (1, 2, 3) if k * w < 128]
+                    x = tree_max([x] + shs)
+                    w *= 4
+                tot = jnp.max(x, axis=2, keepdims=True)
+                p = jnp.broadcast_to(tot, x.shape)
+                acc = jnp.full(x.shape, NEG, jnp.int32)
+                for k in range(1, JC):
+                    acc = jnp.maximum(
+                        acc, jnp.where(lchunk >= k, pltpu.roll(p, k, 0),
+                                       NEG))
+                return jnp.maximum(x, acc)
+
+            # graph-row slots standing in for rk_base/rk_delta[e]/rk_dmax
+            # — real (rank-derived) content so the loads cannot fold away
+            gl_lane = jax.lax.broadcasted_iota(
+                jnp.int32, (GSLOTS, 8, 128), 2)
+            gl_slot = jax.lax.broadcasted_iota(
+                jnp.int32, (GSLOTS, 8, 128), 0)
+            gls[:] = (gl_lane + gl_slot) % 7
+
+            def dp_ls(r, _):
+                P = H[pl.ds(r % RING, 1)][0]           # (JC, 8, 128)
+                if mode == 10:
+                    # exr-style per-rank graph loads: a DYNAMIC-index
+                    # (1,8,128) row slice + lane mask each, like
+                    # dp_body's ref[pl.ds(r // 128, 1)] reads
+                    lane1p = jax.lax.broadcasted_iota(
+                        jnp.int32, (8, 128), 1)
+                    acc = jnp.int32(0)
+                    for e in range(E):
+                        c = gls[pl.ds((r + e) % GSLOTS, 1)][0]
+                        acc = acc + jnp.sum(
+                            jnp.where(lane1p == (r % 128), c, 0))
+                    # depth-4 delta scan: prior ring rows, masked max;
+                    # acc (from the loads) feeds both the scan depth and
+                    # the row below, so the loads are not eliminable
+                    def dscan(d, Pm):
+                        prow = H[pl.ds((r - d) % RING, 1)][0]
+                        return jnp.where(d <= (acc % 4) + 1,
+                                         jnp.maximum(Pm, prow), Pm)
+                    P = jax.lax.fori_loop(1, 5, dscan, P)
+                    P = P + (acc & 1)
+                scvec = jnp.where(ljj % 4 == 1, 5, -4)
+                diag = shiftr_ls(P, NEG) + scvec
+                up = P + G
+                V = jnp.where(diag >= up, diag, up)
+                row = cummax_ls(V - lg) + lg
+                H[pl.ds((r + 1) % RING, 1)] = row.reshape(1, JC, 8, 128)
+                return 0
+
+            jax.lax.fori_loop(0, R, dp_ls, 0)
+            hr = H[pl.ds(R % RING, 1)][0]
+            out_ref[0, 0, 0] = hr[0, 0, 0] + hr[0, 0, 1]
+            return
+
         # graph state init (content irrelevant; loads must be real)
         order[:] = nn_i
         base[:] = nn_i % 4
@@ -279,13 +372,15 @@ def build(mode: int, R: int, B: int, interpret: bool):
         scratch_shapes=[
             pltpu.VMEM((R + 1, 1, 8 * JW) if mode == 6 else
                        (R + 1, 2, 8, JW) if mode == 8 else
-                       (R + 1, 8, JW), jnp.int32),   # H
+                       (RING, JC, 8, 128) if mode in (9, 10) else
+                       (R + 1, 8, JW), jnp.int32),   # H (ring for 9/10)
             pltpu.VMEM((8, NW), jnp.int32),          # order
             pltpu.VMEM((8, NW), jnp.int32),          # base
             pltpu.VMEM((8, NW), jnp.float32),        # key
             pltpu.VMEM((8, NW), jnp.int32),          # in_cnt
             pltpu.VMEM((E, 8, NW), jnp.int32),       # in_src
             pltpu.VMEM((8, NW), jnp.int32),          # has_out
+            pltpu.VMEM((GSLOTS, 8, 128), jnp.int32),  # gls (modes 9/10)
         ],
         interpret=interpret,
     )
@@ -309,7 +404,7 @@ def main():
     interp = platform != "tpu"
     print(f"platform={platform} R={R} B={B}")
     prev = 0.0
-    for mode in range(9):
+    for mode in range(11):
         fn = build(mode, R, B, interp)
         seed = np.zeros((B, 1, 1), np.int32)
         t0 = time.time()
@@ -326,7 +421,7 @@ def main():
             jax.block_until_ready(fn(seed + i + 1))
             dt = time.time() - t0
             best = dt if best is None else min(best, dt)
-        rows = R * B * (2 if mode == 8 else 1)
+        rows = R * B * (2 if mode == 8 else 8 if mode in (9, 10) else 1)
         per_node_us = best / rows * 1e6
         folded = " [FOLDED? output ignores seed — timing is fiction]" \
             if o1 == o2 else ""
