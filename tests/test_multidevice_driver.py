@@ -26,11 +26,13 @@ def _make_dataset(tmp_path, n_targets=3):
     return targets
 
 
-@pytest.mark.parametrize("pallas", ["0", "1"])
-def test_sharded_driver(tmp_path, monkeypatch, pallas):
+@pytest.mark.parametrize("pallas,kind", [("0", "v2"), ("1", "v2"),
+                                         ("1", "ls")])
+def test_sharded_driver(tmp_path, monkeypatch, capsys, pallas, kind):
     assert len(jax.devices()) == 8
     targets = _make_dataset(tmp_path)
     monkeypatch.setenv("RACON_TPU_PALLAS", pallas)
+    monkeypatch.setenv("RACON_TPU_POA_KERNEL", kind)
     monkeypatch.setenv("RACON_TPU_BATCH_WINDOWS", "8")
     p = racon_tpu.TpuPolisher(str(tmp_path / "reads.fasta"),
                               str(tmp_path / "ovl.sam"),
@@ -38,8 +40,26 @@ def test_sharded_driver(tmp_path, monkeypatch, pallas):
                               window_length=100, quality_threshold=10,
                               error_threshold=0.3, match=5, mismatch=-4,
                               gap=-8, num_threads=1)
+    from racon_tpu.ops import poa_driver
+
+    captured = {}
+    orig = poa_driver.run_consensus_phase
+
+    def spy(*a, **k):
+        stats = orig(*a, **k)
+        captured.update(stats)
+        return stats
+
+    monkeypatch.setattr(poa_driver, "run_consensus_phase", spy)
     p.initialize()
     res = p.polish(True)
     assert len(res) == len(targets)
     for (name, data), truth in zip(res, targets):
         assert data == truth
+    # Correct output via a degrade would mask a broken sharded pallas
+    # path: no tier step-down warning, every window served by the
+    # device, none re-polished on the host or failed.
+    assert captured["device"] == len(targets)
+    assert captured["host_fallback"] == 0 and captured["failed"] == 0
+    if pallas == "1":
+        assert "falling back" not in capsys.readouterr().err
