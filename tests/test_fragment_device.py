@@ -38,6 +38,17 @@ def test_fragment_correction_device_path(tmp_path, monkeypatch):
                         f"0\t{len(b)}\t{min(len(a), len(b))}\t"
                         f"{max(len(a), len(b))}\t60\n")
 
+    from racon_tpu.ops import poa_driver
+
+    captured = {}
+    orig = poa_driver.run_consensus_phase
+
+    def spy(*a, **k):
+        stats = orig(*a, **k)
+        captured.update(stats)
+        return stats
+
+    monkeypatch.setattr(poa_driver, "run_consensus_phase", spy)
     monkeypatch.setenv("RACON_TPU_PALLAS", "1")
     monkeypatch.setenv("RACON_TPU_BATCH_WINDOWS", "8")
     p = racon_tpu.TpuPolisher(str(tmp_path / "reads.fasta"),
@@ -53,3 +64,8 @@ def test_fragment_correction_device_path(tmp_path, monkeypatch):
         # corrected read should be closer to truth than the original
         assert (native.edit_distance(corrected.encode(), truth.encode())
                 <= native.edit_distance(original.encode(), truth.encode()))
+    # the device (default ls tier) must actually have served: a silent
+    # per-window host fallback would hide a broken kernel behind correct
+    # output
+    assert captured["device"] > 0
+    assert captured["host_fallback"] == 0 and captured["failed"] == 0

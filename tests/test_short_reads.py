@@ -80,6 +80,17 @@ def test_short_read_polish_device_path(tmp_path, monkeypatch):
     rng = random.Random(23)
     truth, draft = make_dataset(tmp_path, rng, genome_len=1000, coverage=16)
 
+    from racon_tpu.ops import poa_driver
+
+    captured = {}
+    orig = poa_driver.run_consensus_phase
+
+    def spy(*a, **k):
+        stats = orig(*a, **k)
+        captured.update(stats)
+        return stats
+
+    monkeypatch.setattr(poa_driver, "run_consensus_phase", spy)
     monkeypatch.setenv("RACON_TPU_PALLAS", "1")
     monkeypatch.setenv("RACON_TPU_BATCH_WINDOWS", "8")
     p = racon_tpu.TpuPolisher(str(tmp_path / "reads.fastq"),
@@ -93,3 +104,8 @@ def test_short_read_polish_device_path(tmp_path, monkeypatch):
     assert len(res) == 1
     ed = native.edit_distance(res[0][1].encode(), truth.encode())
     assert ed <= 3, ed
+    # the device (default ls tier) must actually have served: a silent
+    # per-window host fallback would hide a broken kernel behind correct
+    # output
+    assert captured["device"] > 0
+    assert captured["host_fallback"] == 0 and captured["failed"] == 0
