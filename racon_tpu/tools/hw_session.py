@@ -17,8 +17,9 @@ session moves on. Priorities:
   4. bench_sam_v2 — same with RACON_TPU_POA_KERNEL=v2: the on-chip
                     ls-vs-v2 tier decision (45 min)
   5. bench5       — RACON_TPU_BENCH_MBP=5 scale run (90 min)
-  6. pins         — pin_device_golden.py all: every golden scenario's
-                    device number in one pass (60 min)
+  6. pin_<scenario> — one bounded pin_device_golden.py run per golden
+                    scenario (10 min each; 'pins' expands to all nine —
+                    a wedge mid-scenario cannot cost the remaining pins)
   7. aligner      — Hirschberg vs host phase-1 measurement via
                     RACON_TPU_DEVICE_ALIGNER=hirschberg bench at 0.5 Mbp
                     (45 min; decides align_driver's default)
@@ -41,6 +42,7 @@ import time
 
 HERE = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, HERE)
 LOG = os.path.join(HERE, "docs", "hw_session_log.jsonl")
 
 PROBE = ("import jax, jax.numpy as jnp; "
@@ -58,11 +60,37 @@ STEPS = [
      {"RACON_TPU_BENCH_INPUT": "sam", "RACON_TPU_POA_KERNEL": "v2"}),
     ("bench5", [sys.executable, "bench.py"], 5400,
      {"RACON_TPU_BENCH_MBP": "5"}),
-    ("pins", [sys.executable, "racon_tpu/tools/pin_device_golden.py",
-              "all"], 3600, {}),
     ("aligner", [sys.executable, "bench.py"], 2700,
      {"RACON_TPU_DEVICE_ALIGNER": "hirschberg"}),
 ]
+
+
+def _pin_steps():
+    """One bounded step per golden scenario (a wedge mid-scenario must
+    not cost the remaining pins); λ is small, so 600 s each is ample.
+
+    golden_scenarios.py is loaded by file path: it has zero imports,
+    while importing it as racon_tpu.tools.golden_scenarios would pull the
+    whole package (native extension included) into the ORCHESTRATOR
+    process, which must stay dependency-free so steps can run bounded
+    even when the package itself is broken."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "golden_scenarios",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "golden_scenarios.py"))
+    gs = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gs)
+    return [(f"pin_{name}",
+             [sys.executable, "racon_tpu/tools/pin_device_golden.py",
+              name], 600, {})
+            for name in list(gs.POLISH) + list(gs.FRAGMENT)]
+
+
+# pins run after the throughput benches, before the aligner measurement
+_aligner_i = next(i for i, (n, *_) in enumerate(STEPS) if n == "aligner")
+STEPS = STEPS[:_aligner_i] + _pin_steps() + STEPS[_aligner_i:]
 
 
 def log_step(entry):
@@ -113,10 +141,13 @@ def run_step(name, cmd, bound_s, extra_env):
 
 def main():
     wanted = sys.argv[1:] or [n for n, *_ in STEPS]
+    if "pins" in wanted:  # convenience alias for all nine pin steps
+        i = wanted.index("pins")
+        wanted[i:i + 1] = [n for n, *_ in STEPS if n.startswith("pin_")]
     unknown = set(wanted) - {n for n, *_ in STEPS}
     if unknown:
         sys.exit(f"unknown steps {sorted(unknown)}; "
-                 f"available: {[n for n, *_ in STEPS]}")
+                 f"available: {[n for n, *_ in STEPS]} (or 'pins')")
     for name, cmd, bound, env in STEPS:
         if name not in wanted:
             continue
