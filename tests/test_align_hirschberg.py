@@ -157,3 +157,31 @@ def test_cigar_roundtrip():
             if ch in "MD":
                 tc += n
     assert qc == len(q) and tc == len(t)
+
+
+@pytest.mark.parametrize("seed", [31, 62])
+def test_hirschberg_fuzz_exact(seed):
+    """Seeded random pairs across the length/error envelope phase 1
+    serves (short fragments up to multi-kb reads, 2-18% divergence,
+    length skew): every emitted path must be valid and cost-optimal;
+    None (band escape / oversize) is acceptable only where the band
+    rule says so."""
+    rng = random.Random(seed)
+    pairs = []
+    for _ in range(6):
+        n = rng.randrange(60, 2500)
+        q = _rand(rng, n)
+        t = mutate(q, rng.uniform(0.02, 0.18), rng)
+        pairs.append((q, t))
+    enc = [(encode(np.frombuffer(q, np.uint8)).astype(np.int32),
+            encode(np.frombuffer(t, np.uint8)).astype(np.int32))
+           for q, t in pairs]
+    results = align_pallas.align_pairs(enc, interpret=True)
+    n_served = 0
+    for (q, t), ops in zip(pairs, results):
+        if ops is None:
+            continue
+        n_served += 1
+        assert path_cost(ops, q, t) == native.edit_distance(q, t), \
+            (seed, len(q), len(t))
+    assert n_served >= len(pairs) - 1, "band escapes should be rare here"
