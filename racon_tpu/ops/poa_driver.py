@@ -182,7 +182,7 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
                     # an earlier chunk (or the warm-up) proved this tier
                     # dead for this geometry: step down before dispatching
                     bucket_pallas, kernel, bucket_kind = _step_down(
-                        cfg, B, bucket_kind)
+                        cfg, B, bucket_kind, dead_geoms)
                 idxs = [i for i, _, _ in bucket_jobs[off:off + B]]
                 # Always pad to B: a dataset-size-dependent final-chunk
                 # shape would force an extra jit compile per distinct
@@ -201,10 +201,10 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
                             raise
                         dead_geoms.add((cfg, bucket_kind))
                         bucket_pallas, kernel, bucket_kind = _degrade(
-                            e, cfg, B, bucket_kind)
+                            e, cfg, B, bucket_kind, dead_geoms)
                 pending.append((chunk, packed, outs, cfg, bucket_pallas,
                                 bucket_kind))
-                if len(pending) > q_depth:
+                if len(pending) >= q_depth:
                     _drain(pipeline, pending.popleft(), trim, stats,
                            fallback, B, dead_geoms)
             if progress:
@@ -261,7 +261,7 @@ def warm_geometries(window_length: int, match: int, mismatch: int,
                     raise
                 _WARM_DEAD.add((cfg, bucket_kind))
                 bucket_pallas, kernel, bucket_kind = _degrade(
-                    e, cfg, B, bucket_kind)
+                    e, cfg, B, bucket_kind, _WARM_DEAD)
 
 
 def _pick_tier(cfg, use_pallas: bool, kind: str):
@@ -276,19 +276,22 @@ def _pick_tier(cfg, use_pallas: bool, kind: str):
     return False, kind
 
 
-def _step_down(cfg, B, kind):
-    """Next tier below (pallas `kind`) for this geometry:
-    ls -> v2 (if it fits) -> XLA. Returns (use_pallas, kernel, kind)."""
-    if kind == "ls" and _fits_vmem(cfg, "v2"):
+def _step_down(cfg, B, kind, dead_geoms=()):
+    """Next LIVE tier below (pallas `kind`) for this geometry:
+    ls -> v2 (if it fits and isn't already proven dead) -> XLA.
+    Returns (use_pallas, kernel, kind)."""
+    if (kind == "ls" and _fits_vmem(cfg, "v2")
+            and (cfg, "v2") not in dead_geoms):
         return True, _build_kernel(cfg, B, True, "v2"), "v2"
     return False, _build_kernel(cfg, B, False, kind), kind
 
 
-def _degrade(e, cfg, B, kind):
-    """Mosaic compile/runtime failure: fall back to the next kernel tier
-    for the rest of this geometry (same philosophy as the per-window host
-    fallback)."""
-    use_p, kernel, new_kind = _step_down(cfg, B, kind)
+def _degrade(e, cfg, B, kind, dead_geoms=()):
+    """Mosaic compile/runtime failure: fall back to the next live kernel
+    tier for this geometry (same philosophy as the per-window host
+    fallback). Tiers already in dead_geoms are skipped so a drain-time ls
+    failure doesn't pay a doomed submit through an already-dead v2."""
+    use_p, kernel, new_kind = _step_down(cfg, B, kind, dead_geoms)
     tier = f"pallas '{new_kind}'" if use_p else "XLA"
     print("[racon_tpu::poa] WARNING: pallas kernel failed "
           f"({type(e).__name__}: {e}); falling back to the {tier} kernel",
@@ -317,7 +320,7 @@ def _drain(pipeline, pending, trim, stats, fallback, B, dead_geoms):
             if not was_pallas:
                 raise
             dead_geoms.add((cfg, kind))
-            was_pallas, kernel, kind = _degrade(e, cfg, B, kind)
+            was_pallas, kernel, kind = _degrade(e, cfg, B, kind, dead_geoms)
             outs = None  # re-submit inside the try: a synchronous failure
             # of the intermediate v2 tier must also degrade, not escape
     _install(pipeline, chunk, results, trim, stats, fallback)
@@ -503,15 +506,19 @@ def _install(pipeline, chunk, results, trim, stats, fallback):
         cov = cons_cov[bi, :cl]
         out = np.asarray(codes)
         if wx.is_tgs and trim:
-            # Threshold on the window's FULL sequence count (backbone +
-            # every layer, even ones admission dropped as oversized or
-            # beyond DEPTH_CAP) — the host rule divides by
-            # sequences.size()-1 (rt_window.cpp:113-115; reference
-            # src/window.cpp:125-146), and the reference's accelerator
-            # path trims with the same window-level count after the GPU
-            # consensus returns (src/cuda/cudabatch.cpp:199-261).
-            n_window_seqs = len(wx.lens) + 1
-            kept_codes = tgs_trim(out, np.asarray(cov), n_window_seqs)
+            # Threshold on the ADMITTED sequence count (backbone + the
+            # layers this driver actually packed), mirroring the
+            # reference accelerator's seqs_added_per_window_ rule — it
+            # counts only sequences successfully added to the GPU group
+            # (src/cuda/cudabatch.cpp:139-163,233), not the window's full
+            # layer count. Device coverage can only ever reach the
+            # admitted count, so a full-window threshold (the CPU rule,
+            # src/window.cpp:125-146) would over-trim between DEPTH_CAP
+            # and 2*DEPTH_CAP layers and silently never trim above
+            # 2*DEPTH_CAP. Host parity therefore holds exactly where the
+            # two counts coincide: depth <= DEPTH_CAP.
+            n_admitted_seqs = len(keep) + 1
+            kept_codes = tgs_trim(out, np.asarray(cov), n_admitted_seqs)
         else:
             kept_codes = out
         pipeline.set_consensus(i, decode(kept_codes), True)

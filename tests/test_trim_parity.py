@@ -1,23 +1,29 @@
-"""Device-vs-host trim parity for windows deeper than DEPTH_CAP.
+"""Device trim rule for windows deeper than DEPTH_CAP: admitted count.
 
 The reference's accelerator path computes the low-coverage end-trim
-threshold from the WINDOW's sequence count, not from the subset of layers
-the GPU batch actually incorporated (src/cuda/cudabatch.cpp:199-261 trims
-with the same (sequences_.size()-1)/2 rule the CPU window uses,
-src/window.cpp:125-146). The device driver here admits at most
-DEPTH_CAP=200 layers per window, so for deeper windows the two counts
-diverge — this test pins the host rule.
+threshold from seqs_added_per_window_ — the count of sequences actually
+incorporated into the GPU group, excluding drops for exceeded size/depth
+(src/cuda/cudabatch.cpp:139-163,233) — while the CPU path uses the
+window's full sequence count (src/window.cpp:125-146). The device driver
+here admits at most DEPTH_CAP=200 layers per window, so for deeper
+windows the two counts diverge; this test pins the reference-GPU rule on
+the device path. The admitted-count rule is also the only self-consistent
+one: device coverage is computed from the admitted layers, so a
+full-window threshold would be unattainable above 2*DEPTH_CAP layers
+(trim would silently no-op via the chimeric guard) and over-trim between
+DEPTH_CAP and 2*DEPTH_CAP.
 
 Scenario (210 layers > DEPTH_CAP): a 100-base backbone where
 - 102 layers span positions 0..79  (head + core),
 - 108 layers span positions 15..79 (core only),
 - positions 80..99 are backbone-only (tail).
 
-Full-count threshold: (211-1)/2 = 105. Head coverage is 102+1 = 103 < 105
--> head must be trimmed (so must the tail, coverage 1). A threshold
-computed from the 200 admitted layers instead gives (201-1)/2 = 100 <= 103
-and wrongly keeps the head. Perfect reads make device and host consensus
-base-identical, so the only difference a wrong threshold can produce is
+Host (full-count) threshold: (211-1)/2 = 105. Host head coverage is
+102+1 = 103 < 105 -> host trims the head (and the tail, coverage 1).
+Device admits the first 200 layers in layer order (all 102 head + 98
+core), threshold (201-1)/2 = 100 <= 103 -> device keeps the head and
+trims only the tail. Perfect reads make device and host consensus
+base-identical, so the only difference the threshold rule can produce is
 exactly the trim extent.
 """
 
@@ -71,8 +77,8 @@ def _polish(tmp_path, backend, monkeypatch):
     return p.polish(True)
 
 
-def test_depth_over_cap_trim_threshold_uses_window_count(tmp_path,
-                                                         monkeypatch):
+def test_depth_over_cap_trim_threshold_uses_admitted_count(tmp_path,
+                                                           monkeypatch):
     rng = random.Random(3)
     truth = "".join(rng.choice("ACGT") for _ in range(100))
     _write_dataset(tmp_path, truth)
@@ -82,7 +88,8 @@ def test_depth_over_cap_trim_threshold_uses_window_count(tmp_path,
     dev = _polish(tmp_path, "tpu", monkeypatch)
 
     assert len(host) == 1 and len(dev) == 1
-    # trimmed to the core region on both paths (head cov 103 < 105,
-    # tail cov 1) — an admitted-count threshold (100) would keep the head
+    # host: full-count threshold 105 > head cov 103 -> head trimmed
     assert host[0][1] == truth[HEAD_END:CORE_END]
-    assert dev[0][1] == host[0][1]
+    # device: admitted-count threshold 100 <= head cov 103 -> head kept,
+    # tail (cov 1) trimmed — the reference-GPU seqs_added rule
+    assert dev[0][1] == truth[:CORE_END]
