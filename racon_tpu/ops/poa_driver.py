@@ -67,6 +67,24 @@ def _device_batch(n_dev: int, kind: str) -> int:
     return B
 
 
+def _node_factor() -> int:
+    """max_nodes = factor * window_length. The default 3 matches the
+    geometry every recorded pin was measured under; repeat-dense windows
+    (4 of λ's 96) overflow it and fall back to the host, so hw_session
+    measures factor 4 (VMEM fits per docs/roadmap.md) for a same-session
+    pin refresh — the reference's per-entry capacity rejection is the
+    analogous knob (/root/reference/src/cuda/cudabatch.cpp:141-160)."""
+    return max(1, int(os.environ.get("RACON_TPU_NODE_FACTOR", "3")))
+
+
+def window_class(bb_len: int) -> int:
+    """Kernel-geometry class for a backbone length: ceil to the 128-lane
+    grid. Windows bucket by (depth, class) so one long-window target in a
+    mixed run no longer inflates every bucket's geometry — short windows
+    pay their own class's DP ranges, not the global maximum's."""
+    return max(128, (bb_len + 127) // 128 * 128)
+
+
 def make_config(window_length: int, depth: int, match: int, mismatch: int,
                 gap: int) -> poa.PoaConfig:
     def ceil128(x):
@@ -74,7 +92,7 @@ def make_config(window_length: int, depth: int, match: int, mismatch: int,
 
     max_backbone = ceil128(window_length)
     max_len = ceil128(window_length + window_length // 2)
-    max_nodes = ceil128(3 * window_length)
+    max_nodes = ceil128(_node_factor() * window_length)
     return poa.PoaConfig(max_nodes=max_nodes, max_len=max_len,
                          max_backbone=max_backbone, max_edges=12,
                          depth=depth, match=match, mismatch=mismatch,
@@ -114,13 +132,11 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
     stats = {"device": 0, "host_fallback": 0, "backbone": 0, "failed": 0}
 
     fallback: List[int] = []
-    window_length = 0
 
     # Metadata pass: geometry + depth buckets, no layer bytes touched.
     jobs = []          # (window_idx, estimated depth, backbone len)
     for i in range(n):
         n_seqs, bb_len, _rank, _is_tgs, _bytes, _tid = pipeline.window_info(i)
-        window_length = max(window_length, bb_len)
         k = n_seqs - 1
         if k < 2:
             # <3 sequences incl. backbone: backbone passthrough
@@ -136,13 +152,17 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
         kind = _kernel_kind()
         B = _device_batch(n_dev, kind)
         use_pallas = _use_pallas()
-        # Bucket by depth to bound padding waste. Layers dropped at pack
-        # time (oversized/empty) only shrink a window's true depth, so a
-        # window always fits the bucket its estimate chose.
+        # Bucket by (depth, backbone class) to bound padding waste in BOTH
+        # dims: layers dropped at pack time (oversized/empty) only shrink
+        # a window's true depth, so a window always fits the bucket its
+        # estimate chose; and short windows run in their own 128-grid
+        # geometry class instead of the dataset-max geometry (one long
+        # target in a mixed run used to inflate every bucket's DP ranges).
         buckets = {}
         for i, depth, bb in jobs:
             bucket = next(b for b in DEPTH_BUCKETS if depth <= b)
-            buckets.setdefault(bucket, []).append((i, depth, bb))
+            buckets.setdefault((bucket, window_class(bb)),
+                               []).append((i, depth, bb))
 
         # In-flight chunks: (chunk, packed, outs, cfg, pallas, kind).
         # JAX dispatch is async, so with depth Q the host packs/exports
@@ -157,9 +177,8 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
         # seeded from warm-up failures so the measured run never retries
         # a kernel the warm-up proved dead
         dead_geoms = set(_WARM_DEAD)
-        for depth_bucket, bucket_jobs in sorted(buckets.items()):
-            cfg = make_config(max(window_length, 1), depth_bucket, match,
-                              mismatch, gap)
+        for (depth_bucket, wl_class), bucket_jobs in sorted(buckets.items()):
+            cfg = make_config(wl_class, depth_bucket, match, mismatch, gap)
             # Large window geometries (e.g. -w 1000) overflow the fused
             # kernel's VMEM budget; the flag must flip HERE so _submit and
             # _unpack agree with the kernel _build_kernel actually returns.
@@ -208,8 +227,9 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
                     _drain(pipeline, pending.popleft(), trim, stats,
                            fallback, B, dead_geoms)
             if progress:
-                print(f"[racon_tpu::poa] bucket depth<={depth_bucket}: "
-                      f"{len(bucket_jobs)} windows", file=sys.stderr)
+                print(f"[racon_tpu::poa] bucket depth<={depth_bucket} "
+                      f"len<={wl_class}: {len(bucket_jobs)} windows",
+                      file=sys.stderr)
         while pending:
             _drain(pipeline, pending.popleft(), trim, stats, fallback, B,
                    dead_geoms)
@@ -227,23 +247,28 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
 _WARM_DEAD: set = set()
 
 
-def warm_geometries(window_length: int, match: int, mismatch: int,
+def warm_geometries(window_lengths, match: int, mismatch: int,
                     gap: int) -> None:
     """Compile (or load from the persistent cache) every kernel geometry
-    the consensus phase can pick for this window length.
+    the consensus phase can pick for these window lengths (an int or an
+    iterable of observed backbone lengths — each maps to its 128-grid
+    class, exactly as run_consensus_phase buckets them).
 
-    One all-padding batch per depth bucket (1-base backbones, zero layers)
-    runs in milliseconds but forces the full compile — so a benchmark's
-    measured pass never pays compile time, whatever depth mix the real
-    dataset produces. Tiers that fail here are recorded in _WARM_DEAD so
-    the measured run skips them."""
+    One all-padding batch per (depth bucket, class) runs in milliseconds
+    but forces the full compile — so a benchmark's measured pass never
+    pays compile time, whatever depth/length mix the real dataset
+    produces. Tiers that fail here are recorded in _WARM_DEAD so the
+    measured run skips them."""
+    if isinstance(window_lengths, int):
+        window_lengths = [window_lengths]
+    classes = sorted({window_class(max(w, 1)) for w in window_lengths})
     n_dev = _n_devices()
     kind = _kernel_kind()
     B = _device_batch(n_dev, kind)
     use_pallas = _use_pallas()
-    for depth_bucket in DEPTH_BUCKETS:
-        cfg = make_config(max(window_length, 1), depth_bucket, match,
-                          mismatch, gap)
+    import itertools
+    for depth_bucket, wl_class in itertools.product(DEPTH_BUCKETS, classes):
+        cfg = make_config(wl_class, depth_bucket, match, mismatch, gap)
         bucket_pallas, bucket_kind = _pick_tier(cfg, use_pallas, kind)
         kernel = _build_kernel(cfg, B, bucket_pallas, bucket_kind)
         packed = _pack([], cfg, B)
