@@ -58,26 +58,39 @@ def dataset(mbp: float = MBP):
                          ("draft", "draft.fasta"))}
 
 
-def observed_window_length(draft_path: str, w: int) -> int:
-    """The window length the consensus phase will actually derive.
+def observed_window_lengths(draft_path: str, w: int) -> set:
+    """Every window length the consensus phase will actually derive.
 
-    run_consensus_phase sizes its kernel geometry from the OBSERVED max
-    backbone length, not the nominal -w (poa_driver.py metadata pass).
-    Windows are fixed-size chunks of draft contigs, so that maximum is
-    computable from the draft FASTA alone: max over contigs of
-    min(contig_len, w). Warming the nominal w when every contig is shorter
-    would compile a geometry the measured run never uses — and pay the
-    real geometry's compile inside the timed pass."""
-    best = 1
+    run_consensus_phase buckets kernel geometry by the OBSERVED backbone
+    classes, not the nominal -w (poa_driver.py metadata pass). Windows
+    are fixed-size chunks of draft contigs (rt_pipeline.cpp window
+    build), so the set is computable from the draft FASTA alone: per
+    contig, w for the full chunks plus the tail remainder. Warming only
+    the nominal w would leave the tail-class geometries to compile
+    inside the timed pass."""
+    lens = set()
+
+    def add(contig_len):
+        if contig_len <= 0:
+            return
+        if contig_len >= w:
+            lens.add(w)
+        rem = contig_len % w
+        if contig_len < w:
+            lens.add(contig_len)
+        elif rem:
+            lens.add(rem)
+
     cur = 0
     with open(draft_path) as f:
         for line in f:
             if line.startswith(">"):
-                best = max(best, min(cur, w))
+                add(cur)
                 cur = 0
             else:
                 cur += len(line.strip())
-    return max(best, min(cur, w))
+    add(cur)
+    return lens or {1}
 
 
 def _forced_device() -> bool:
@@ -151,6 +164,86 @@ def pallas_compiles(timeout_s: int = 900):
     print("[bench] no pallas tier compiles; benching the XLA device "
           "kernel instead", file=sys.stderr)
     return None
+
+
+def aligner_compiles(timeout_s: int = 600):
+    """Bounded probe for the phase-1 device aligner (PAF input only).
+
+    With RACON_TPU_DEVICE_ALIGNER=auto the measured run serves alignment
+    through the Hirschberg Pallas engine on TPU; its three kernel shapes
+    (forward/backward edge, base traceback) have never compiled on real
+    hardware, and a Mosaic compile hang inside the measured run would eat
+    the healthy-tunnel window. Probe one representative pair in a bounded
+    subprocess (same philosophy as pallas_compiles). The engine choice
+    (incl. the platform check behind 'auto') resolves INSIDE the probe
+    subprocess — the parent must not touch jax.devices() before the probe
+    runs, or the parent would hold the chip the probe needs (all this
+    file's probes run before any parent-process device op).
+
+    Returns 'hirschberg' when the engine works (or is explicitly forced:
+    an explicit RACON_TPU_DEVICE_ALIGNER=hirschberg is honored even past
+    a failed probe — the in-process degrade lattice handles errors);
+    'host' when the auto-selected engine fails/hangs (caller pins the
+    host aligner for the measured run); None when the bench doesn't need
+    alignment (SAM input) or the engine resolves to host/xla anyway."""
+    if INPUT == "sam":
+        return None
+    env = os.environ.get("RACON_TPU_DEVICE_ALIGNER", "auto")
+    if _forced_device() or env not in ("auto", "", "hirschberg"):
+        return None
+    forced = env == "hirschberg"
+    probe = (
+        "import sys, random\n"
+        "sys.path.insert(0, %r)\n"
+        "from racon_tpu.ops.align_driver import _engine\n"
+        "if _engine() != 'hirschberg':\n"
+        "    print('engine-host')\n"
+        "    sys.exit(0)\n"
+        "import numpy as np\n"
+        "from racon_tpu.ops import align_pallas\n"
+        "from racon_tpu.ops.encoding import encode\n"
+        "rng = random.Random(0)\n"
+        "q = bytes(rng.choice(b'ACGT') for _ in range(700))\n"
+        "t = bytes(c for c in q if rng.random() > 0.05)\n"
+        "enc = lambda s: encode(np.frombuffer(s, np.uint8)).astype(np.int32)\n"
+        "ops = align_pallas.align_pairs([(enc(q), enc(t))])\n"
+        "assert ops[0] is not None and len(ops[0]) >= len(q)\n"
+        "print('hirschberg-ok', len(ops[0]))\n"
+    ) % os.path.dirname(os.path.abspath(__file__))
+    try:
+        r = subprocess.run([sys.executable, "-c", probe],
+                           capture_output=True, timeout=timeout_s,
+                           text=True)
+        if r.returncode == 0:
+            if "engine-host" in r.stdout:
+                return None
+            return "hirschberg"
+        print("[bench] hirschberg aligner probe failed:",
+              r.stderr[-500:], file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print(f"[bench] hirschberg aligner probe exceeded {timeout_s}s",
+              file=sys.stderr)
+    if forced:
+        print("[bench] RACON_TPU_DEVICE_ALIGNER=hirschberg is explicit; "
+              "keeping it despite the failed probe", file=sys.stderr)
+        return "hirschberg"
+    return "host"
+
+
+def _aligner_log_value(aligner):
+    """What actually served phase 1 in the measured run, for the durable
+    log: the probe outcome when one ran, else the env-selected engine —
+    an explicit xla/1 must not be misrecorded as 'host'."""
+    if INPUT == "sam":
+        return "n/a"
+    if aligner:
+        return aligner
+    env = os.environ.get("RACON_TPU_DEVICE_ALIGNER", "auto")
+    if env in ("1", "xla"):
+        return "xla"
+    if env == "hirschberg":
+        return "hirschberg"
+    return "host"
 
 
 LOG_PATH = os.environ.get(
@@ -266,6 +359,12 @@ def main():
         os.environ["RACON_TPU_PALLAS"] = "0"
     else:
         os.environ["RACON_TPU_POA_KERNEL"] = tier
+    aligner = aligner_compiles()
+    if aligner == "host":
+        # probe failed or hung: pin the host aligner so the measured run
+        # can't stall in an aligner compile (the in-process degrade
+        # lattice handles errors but not hangs)
+        os.environ["RACON_TPU_DEVICE_ALIGNER"] = "host"
 
     # Warm the device path so compile time is not billed as throughput:
     # compile every consensus kernel geometry explicitly (one trivial
@@ -275,9 +374,9 @@ def main():
     # across processes — a full-size warm-up pass would triple device wall
     # at multi-Mbp bench scales.
     from racon_tpu.ops import poa_driver
-    warm_len = observed_window_length(paths["draft"],
-                                      ARGS["window_length"])
-    poa_driver.warm_geometries(warm_len, ARGS["match"],
+    warm_lens = observed_window_lengths(paths["draft"],
+                                        ARGS["window_length"])
+    poa_driver.warm_geometries(warm_lens, ARGS["match"],
                                ARGS["mismatch"], ARGS["gap"])
     run("tpu", dataset(mbp=min(MBP, 0.05)))
 
@@ -296,6 +395,8 @@ def main():
         "mbp": MBP, "input": INPUT, "value": round(mbps_tpu, 4),
         "vs_baseline": round(mbps_tpu / mbps_cpu, 3),
         "pallas": pallas_ok, "kernel": tier or "xla",
+        "aligner": _aligner_log_value(aligner),
+        "node_factor": int(os.environ.get("RACON_TPU_NODE_FACTOR", "3")),
         "tpu_s": round(dt_tpu, 1), "cpu_s": round(dt_cpu, 1),
     })
     print(json.dumps({

@@ -8,57 +8,83 @@ rejection statuses src/cuda/cudaaligner.cpp:63-71).
 from __future__ import annotations
 
 import os
+import sys
 
-import numpy as np
+
+def _on_tpu() -> bool:
+    import jax
+    return jax.devices()[0].platform == "tpu"
 
 
 def _engine() -> str:
-    """Which aligner serves phase 1: 'host' (default), 'hirschberg'
-    (Pallas distance kernels + host-orchestrated splitting — covers
-    full-length reads in O(band) memory), or 'xla' (the moves-matrix
-    kernel, small pairs only).
+    """Which aligner serves phase 1.
 
-    Host stays the default until the Pallas engine has an on-hardware win
-    recorded (docs/benchmarks.md); the reference makes the same call the
-    other way because its GPU aligner is proven
-    (/root/reference/src/cuda/cudapolisher.cpp:74-214).
+    Default 'auto': the Hirschberg engine (Pallas distance kernels +
+    host-orchestrated splitting, O(band) memory — covers full-length
+    reads) on a TPU backend, the host Myers aligner elsewhere — the same
+    device-on-TPU posture as the consensus path (_use_pallas) and the
+    reference, whose accelerator serves phase 1 whenever CUDA devices
+    exist (/root/reference/src/cuda/cudapolisher.cpp:74-214). Explicit
+    overrides: '0'/'host', 'hirschberg', '1'/'xla' (the moves-matrix
+    kernel, small pairs only). A device-engine failure degrades to the
+    host aligner for the remaining jobs (see run_alignment_phase).
     """
-    env = os.environ.get("RACON_TPU_DEVICE_ALIGNER", "0")
-    if env in ("0", ""):
+    env = os.environ.get("RACON_TPU_DEVICE_ALIGNER", "auto")
+    if env in ("auto", ""):
+        return "hirschberg" if _on_tpu() else "host"
+    if env in ("0", "host"):
         return "host"
     if env in ("1", "xla"):
         return "xla"
     if env == "hirschberg":
         return "hirschberg"
-    import sys
     print(f"[racon_tpu::align] WARNING: unknown RACON_TPU_DEVICE_ALIGNER="
-          f"{env!r}; using the host aligner (valid: 0, 1/xla, hirschberg)",
-          file=sys.stderr)
+          f"{env!r}; using the host aligner "
+          f"(valid: auto, 0/host, 1/xla, hirschberg)", file=sys.stderr)
     return "host"
 
 
 def run_alignment_phase(pipeline, progress: bool = False) -> dict:
+    """Device alignment for every eligible CIGAR-less overlap; host for
+    the rest. Any device-engine failure (Mosaic compile/runtime) degrades
+    to the host aligner for the remaining jobs — the phase-1 analogue of
+    the consensus driver's kernel-tier lattice; already-installed CIGARs
+    are kept."""
     stats = {"device": 0, "host": 0}
     n = pipeline.num_align_jobs()
-    engine = _engine()
-    if n and engine != "host":
-        if engine == "hirschberg":
-            from . import align_pallas
+    if n:
+        # engine resolution inside the guard AND the try: with no align
+        # jobs (SAM input) phase 1 must not touch the JAX backend at all,
+        # and a backend-init failure under 'auto' must degrade to host,
+        # not abort the polish.
+        engine = "auto"
+        try:
+            engine = _engine()
+            if engine == "host":
+                pass
+            elif engine == "hirschberg":
+                from . import align_pallas
 
-            lengths = pipeline.align_job_lengths()
-            jobs = [i for i in range(n)
-                    if align_pallas.band_for(int(lengths[i, 0]),
-                                             int(lengths[i, 1])) > 0]
-            if jobs:
-                stats["device"] = align_pallas.run_jobs(pipeline, jobs)
-        else:
-            from . import align
+                lengths = pipeline.align_job_lengths()
+                jobs = [i for i in range(n)
+                        if align_pallas.band_for(int(lengths[i, 0]),
+                                                 int(lengths[i, 1])) > 0]
+                if jobs:
+                    stats["device"] = align_pallas.run_jobs(pipeline, jobs)
+            else:
+                from . import align
 
-            lengths = pipeline.align_job_lengths()
-            jobs = [i for i in range(n)
-                    if align.device_eligible(lengths[i, 0], lengths[i, 1])]
-            if jobs:
-                stats["device"] = align.run_jobs(pipeline, jobs)
+                lengths = pipeline.align_job_lengths()
+                jobs = [i for i in range(n)
+                        if align.device_eligible(lengths[i, 0],
+                                                 lengths[i, 1])]
+                if jobs:
+                    stats["device"] = align.run_jobs(pipeline, jobs)
+        except Exception as e:  # noqa: BLE001
+            print(f"[racon_tpu::align] WARNING: device aligner "
+                  f"'{engine}' failed ({type(e).__name__}: {e}); "
+                  f"finishing the alignment phase on the host",
+                  file=sys.stderr)
     # Host finishes everything still CIGAR-less (device-rejected or
     # ineligible).
     pipeline.align_jobs_cpu()

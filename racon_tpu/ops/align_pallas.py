@@ -513,7 +513,11 @@ def run_jobs(pipeline, jobs, cohort: int = 64) -> int:
     """Align pipeline jobs with the Hirschberg engine; install CIGARs.
     Returns how many the device served (band escapes fall to host).
     Jobs are materialized per cohort so host memory stays O(cohort), not
-    O(total bases)."""
+    O(total bases). A kernel failure (Mosaic compile/runtime) stops the
+    engine and leaves the remaining jobs CIGAR-less for the host — the
+    served count stays accurate for the cohorts already installed."""
+    import sys
+
     served = 0
     for off in range(0, len(jobs), cohort):
         group = jobs[off:off + cohort]
@@ -522,7 +526,13 @@ def run_jobs(pipeline, jobs, cohort: int = 64) -> int:
             qa, ta = pipeline.align_job(job)
             pairs.append((encode(qa).astype(np.int32),
                           encode(ta).astype(np.int32)))
-        results = align_pairs(pairs)
+        try:
+            results = align_pairs(pairs)
+        except Exception as e:  # noqa: BLE001
+            print(f"[racon_tpu::align] WARNING: hirschberg engine failed "
+                  f"({type(e).__name__}: {e}); {len(jobs) - off} remaining "
+                  f"jobs fall back to the host aligner", file=sys.stderr)
+            break
         for job, ops in zip(group, results):
             if ops is None:
                 continue

@@ -73,6 +73,9 @@ HOST_FRAGMENT = {
 # device path (fused Pallas kernel on a real TPU chip) — refreshed by
 # pin_device_golden.py during healthy-tunnel sessions. The reference's GPU
 # pins differ from its CPU pins the same way (racon_test.cpp:316-318).
+# Pins isolate the consensus device path: phase 1 runs on the HOST aligner
+# (pin_device_golden.py pins RACON_TPU_DEVICE_ALIGNER=host; the paf=1282
+# measurement predates the hirschberg-on-TPU default and was host-phase-1).
 DEVICE_POLISH = {
     "paf": 1282,        # v5e, 2026-07-29: one edit from host's 1283
     "sam": None,

@@ -20,9 +20,22 @@ session moves on. Priorities:
   6. pin_<scenario> — one bounded pin_device_golden.py run per golden
                     scenario (10 min each; 'pins' expands to all nine —
                     a wedge mid-scenario cannot cost the remaining pins)
-  7. aligner      — Hirschberg vs host phase-1 measurement via
-                    RACON_TPU_DEVICE_ALIGNER=hirschberg bench at 0.5 Mbp
-                    (45 min; decides align_driver's default)
+  7. aligner      — explicit RACON_TPU_DEVICE_ALIGNER=hirschberg bench
+                    at 0.5 Mbp (45 min). Note the default `bench` step
+                    already serves phase 1 through hirschberg when its
+                    bounded probe passes (align_driver default is `auto`);
+                    this step forces it even past a failed probe.
+  8. aligner_host — same bench with RACON_TPU_DEVICE_ALIGNER=host: the
+                    other half of the phase-1 engine decision, same
+                    dataset (45 min)
+  9. jobs2        — wrapper --split --jobs 2 --tpu over the bench
+                    dataset: the multi-host rehearsal (chunk × process
+                    fan-out against one chip — the honest available
+                    approximation of BASELINE config 5) (60 min)
+ 10. factor4      — bench with RACON_TPU_NODE_FACTOR=4: deep-window
+                    node capacity (admits the 4 repeat-dense λ windows
+                    the default rejects); its golden re-pin rides the
+                    bench's opportunistic λ pin (45 min)
 
 Usage:
     python racon_tpu/tools/hw_session.py           # all steps in order
@@ -62,6 +75,27 @@ STEPS = [
      {"RACON_TPU_BENCH_MBP": "5"}),
     ("aligner", [sys.executable, "bench.py"], 2700,
      {"RACON_TPU_DEVICE_ALIGNER": "hirschberg"}),
+    ("aligner_host", [sys.executable, "bench.py"], 2700,
+     {"RACON_TPU_DEVICE_ALIGNER": "host"}),
+    ("jobs2", [sys.executable, "-c", (
+        "import sys, time, subprocess\n"
+        "sys.path.insert(0, '.')\n"
+        "import bench\n"
+        "paths = bench.dataset()\n"
+        "t0 = time.time()\n"
+        "r = subprocess.run([sys.executable, '-m',"
+        " 'racon_tpu.tools.wrapper', paths['reads'], paths['overlaps'],"
+        " paths['draft'], '--split', '200000', '--jobs', '2', '--tpu'],"
+        " capture_output=True, text=True)\n"
+        "dt = time.time() - t0\n"
+        "sys.stderr.write(r.stderr[-1500:])\n"
+        "bp = sum(len(l.strip()) for l in r.stdout.splitlines()"
+        " if not l.startswith('>'))\n"
+        "print('jobs2 rc=%d bp=%d wall=%.1fs Mbp/s=%.4f'\n"
+        "      % (r.returncode, bp, dt, bp / dt / 1e6))\n"
+        "assert r.returncode == 0\n")], 3600, {}),
+    ("factor4", [sys.executable, "bench.py"], 2700,
+     {"RACON_TPU_NODE_FACTOR": "4"}),
 ]
 
 

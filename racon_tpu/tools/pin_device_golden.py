@@ -29,6 +29,14 @@ from racon_tpu.tools import golden_scenarios as gs
 # this tool must not inherit the test suite's CPU-mesh forcing)
 DATA = os.environ.get("RACON_TPU_TEST_DATA", "/root/reference/test/data/")
 
+# The device pins isolate the CONSENSUS device path: phase 1 runs on the
+# host aligner unless the caller overrides. The existing paf=1282 pin was
+# measured under the host aligner (2026-07-29, before 'auto' defaulted
+# phase 1 to hirschberg-on-TPU); pinning the engine here keeps every
+# refresh comparable to it. Hirschberg-phase-1 accuracy is covered by the
+# hw_session aligner steps, not these pins.
+os.environ.setdefault("RACON_TPU_DEVICE_ALIGNER", "host")
+
 ARGS = gs.ARGS  # single source: the args the asserted pins are defined by
 
 COMP = bytes.maketrans(b"ACGT", b"TGCA")
@@ -81,7 +89,8 @@ def main():
         # hardware golden (the axon tunnel silently falls back when down)
         sys.exit(f"refusing to measure: platform is {platform!r}, not tpu")
     tier = os.environ.get("RACON_TPU_POA_KERNEL", "ls")
-    print(f"platform={platform} kernel_tier={tier}")
+    aligner = os.environ.get("RACON_TPU_DEVICE_ALIGNER")
+    print(f"platform={platform} kernel_tier={tier} aligner={aligner}")
 
     names = known if scenario == "all" else [scenario]
     for name in names:

@@ -138,6 +138,67 @@ def test_polish_with_hirschberg_engine(tmp_path, monkeypatch):
     assert native.edit_distance(dev[0][1].encode(), truth.encode()) <= 8
 
 
+def test_engine_auto_defaults_to_hirschberg_on_tpu(monkeypatch):
+    """With no env override, the production tier is the Hirschberg engine
+    on a TPU backend and the host Myers aligner elsewhere — the same
+    device-on-TPU posture as the consensus path."""
+    from racon_tpu.ops import align_driver
+
+    monkeypatch.delenv("RACON_TPU_DEVICE_ALIGNER", raising=False)
+    monkeypatch.setattr(align_driver, "_on_tpu", lambda: True)
+    assert align_driver._engine() == "hirschberg"
+    monkeypatch.setattr(align_driver, "_on_tpu", lambda: False)
+    assert align_driver._engine() == "host"
+    monkeypatch.setenv("RACON_TPU_DEVICE_ALIGNER", "host")
+    monkeypatch.setattr(align_driver, "_on_tpu", lambda: True)
+    assert align_driver._engine() == "host"
+
+
+def test_engine_failure_degrades_to_host(tmp_path, monkeypatch):
+    """A hirschberg kernel failure mid-phase must not abort the polish:
+    the remaining jobs stay CIGAR-less and the host aligner finishes
+    them, mirroring the consensus driver's degrade lattice."""
+    import racon_tpu
+    from racon_tpu.ops import align_driver, align_pallas as ap
+
+    rng = random.Random(17)
+    truth = "".join(rng.choice("ACGT") for _ in range(300))
+    reads = [truth for _ in range(3)]
+    with open(tmp_path / "t.fasta", "w") as f:
+        f.write(f">t\n{truth}\n")
+    with open(tmp_path / "r.fasta", "w") as rf, \
+            open(tmp_path / "o.paf", "w") as of:
+        for i, r in enumerate(reads):
+            rf.write(f">r{i}\n{r}\n")
+            of.write(f"r{i}\t{len(r)}\t0\t{len(r)}\t+\tt\t{len(truth)}\t0\t"
+                     f"{len(truth)}\t{len(r)}\t{len(r)}\t60\n")
+
+    def boom(pairs, *, interpret=None):
+        raise RuntimeError("synthetic Mosaic failure")
+
+    monkeypatch.setenv("RACON_TPU_DEVICE_ALIGNER", "hirschberg")
+    monkeypatch.setattr(ap, "align_pairs", boom)
+    p = racon_tpu.TpuPolisher(str(tmp_path / "r.fasta"),
+                              str(tmp_path / "o.paf"),
+                              str(tmp_path / "t.fasta"),
+                              window_length=100, match=5, mismatch=-4,
+                              gap=-8)
+    p.initialize()
+    res = p.polish(True)
+    assert len(res) == 1
+    assert res[0][1] == truth
+
+    # and the driver's stats record the degrade: nothing device-served
+    pipe = racon_tpu.pipeline.Pipeline(
+        str(tmp_path / "r.fasta"), str(tmp_path / "o.paf"),
+        str(tmp_path / "t.fasta"), window_length=100, match=5,
+        mismatch=-4, gap=-8)
+    pipe.prepare()
+    stats = align_driver.run_alignment_phase(pipe)
+    assert stats["device"] == 0
+    assert stats["host"] == pipe.num_align_jobs()
+
+
 def test_cigar_roundtrip():
     rng = random.Random(5)
     q = _rand(rng, 300)

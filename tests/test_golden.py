@@ -157,6 +157,10 @@ def test_device_path_golden(name, lambda_reference, monkeypatch):
         pytest.fail("RACON_TPU_HW_TESTS=1 but the JAX platform is not tpu "
                     "— hardware pin not exercised")
     is_polish = name in gs.POLISH
+    # the device pins isolate the consensus path: phase 1 on the host
+    # aligner, matching pin_device_golden.py's pinned measurement
+    # conditions (the hirschberg-on-TPU default postdates the paf pin)
+    monkeypatch.setenv("RACON_TPU_DEVICE_ALIGNER", "host")
     if _on_tpu():
         pin = (gs.DEVICE_POLISH if is_polish else gs.DEVICE_FRAGMENT)[name]
         if pin is None:
