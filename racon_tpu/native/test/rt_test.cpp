@@ -6,18 +6,31 @@
 // Plain CHECK macros instead of a vendored gtest: the framework must build
 // with zero network access, and the assertions here are simple equality
 // checks. Build + run:  make -C racon_tpu/native test
+#include <zlib.h>
+
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
+#include <fstream>
 #include <memory>
+#include <set>
 #include <string>
+#include <sys/stat.h>
+#include <unistd.h>
 #include <unordered_map>
 #include <vector>
 
 #include "../src/rt_align.hpp"
+#include "../src/rt_error.hpp"
 #include "../src/rt_overlap.hpp"
+#include "../src/rt_parsers.hpp"
+#include "../src/rt_pipeline.hpp"
 #include "../src/rt_poa.hpp"
+#include "../src/rt_sampler.hpp"
 #include "../src/rt_sequence.hpp"
+#include "../src/rt_threadpool.hpp"
+#include "../src/rt_window.hpp"
 
 static int g_failures = 0;
 static int g_checks = 0;
@@ -190,15 +203,302 @@ static void test_poa() {
   CHECK_EQ(cov[0], 4u);  // agreeing base: backbone + 3 layers
 }
 
+// ---- temp-file helpers -----------------------------------------------------
+
+static std::string g_tmpdir;
+
+static std::string write_file(const std::string& name,
+                              const std::string& content) {
+  const std::string path = g_tmpdir + "/" + name;
+  std::ofstream f(path, std::ios::binary);
+  f << content;
+  return path;
+}
+
+static std::string write_gz(const std::string& name,
+                            const std::string& content) {
+  const std::string path = g_tmpdir + "/" + name;
+  gzFile f = gzopen(path.c_str(), "wb");
+  gzwrite(f, content.data(), static_cast<unsigned>(content.size()));
+  gzclose(f);
+  return path;
+}
+
+// ---- parsers ---------------------------------------------------------------
+// Format coverage parity with the reference's vendored bioparser formats
+// (reference factory: src/polisher.cpp:85-135).
+
+static void test_parsers() {
+  // extension sniffing: the reference's accepted extension sets
+  rt::SeqFormat sf;
+  rt::OvlFormat of;
+  CHECK(rt::sniff_sequence_format("x.fasta", &sf) && sf == rt::SeqFormat::kFasta);
+  CHECK(rt::sniff_sequence_format("x.fq.gz", &sf) && sf == rt::SeqFormat::kFastq);
+  CHECK(!rt::sniff_sequence_format("x.txt", &sf));
+  CHECK(rt::sniff_overlap_format("x.paf.gz", &of) && of == rt::OvlFormat::kPaf);
+  CHECK(rt::sniff_overlap_format("x.mhap", &of) && of == rt::OvlFormat::kMhap);
+  CHECK(rt::sniff_overlap_format("x.sam", &of) && of == rt::OvlFormat::kSam);
+  CHECK(!rt::sniff_overlap_format("x.bam", &of));
+
+  // multi-line FASTA, name ends at first whitespace
+  const std::string fasta = ">r1 comment here\nACGT\nACGT\n>r2\nTTTT\n";
+  rt::SequenceParser fp(write_file("t.fasta", fasta), rt::SeqFormat::kFasta);
+  auto seqs = fp.parse(0);
+  CHECK_EQ(seqs.size(), 2u);
+  CHECK_EQ(seqs[0]->name, std::string("r1"));
+  CHECK_EQ(seqs[0]->data, std::string("ACGTACGT"));
+  CHECK_EQ(seqs[1]->data, std::string("TTTT"));
+
+  // chunked parse: max_bytes=1 pulls one record per call; reset rewinds
+  fp.reset();
+  auto first = fp.parse(1);
+  CHECK_EQ(first.size(), 1u);
+  auto second = fp.parse(1);
+  CHECK_EQ(second.size(), 1u);
+  CHECK_EQ(second[0]->name, std::string("r2"));
+  CHECK_EQ(fp.parse(1).size(), 0u);
+
+  // FASTQ with informative quality
+  const std::string fastq = "@q1\nACGT\n+\n!5!5\n";
+  rt::SequenceParser qp(write_file("t.fastq", fastq), rt::SeqFormat::kFastq);
+  auto qseqs = qp.parse(0);
+  CHECK_EQ(qseqs.size(), 1u);
+  CHECK_EQ(qseqs[0]->quality, std::string("!5!5"));
+
+  // transparent gzip through the same parser (reference: bioparser + zlib)
+  rt::SequenceParser gz(write_gz("t2.fasta.gz", fasta), rt::SeqFormat::kFasta);
+  CHECK_EQ(gz.parse(0).size(), 2u);
+
+  // PAF / SAM (headers skipped) / MHAP overlap records
+  rt::OverlapParser pp(
+      write_file("t.paf", "q\t100\t0\t80\t+\tt\t200\t10\t110\t70\t100\t60\n"),
+      rt::OvlFormat::kPaf);
+  auto povl = pp.parse(0);
+  CHECK_EQ(povl.size(), 1u);
+  CHECK_EQ(povl[0]->t_begin, 10u);
+
+  rt::OverlapParser sp(
+      write_file("t.sam",
+                 "@HD\tVN:1.6\n@SQ\tSN:t\tLN:200\n"
+                 "q\t0\tt\t11\t60\t20M5I20M5D20M\t*\t0\t0\t*\t*\n"),
+      rt::OvlFormat::kSam);
+  auto sovl = sp.parse(0);
+  CHECK_EQ(sovl.size(), 1u);
+  CHECK_EQ(sovl[0]->q_end, 65u);
+
+  rt::OverlapParser mp(
+      write_file("t.mhap", "1 2 0.1 10 0 0 80 100 1 10 110 200\n"),
+      rt::OvlFormat::kMhap);
+  auto movl = mp.parse(0);
+  CHECK_EQ(movl.size(), 1u);
+  CHECK(movl[0]->strand);
+
+  // library error channel, not exit(): missing file and malformed records
+  // throw rt::Error (the CLI catches at main, rt_main.cpp)
+  bool threw = false;
+  try {
+    rt::GzReader bad(g_tmpdir + "/does_not_exist.fasta");
+  } catch (const rt::Error& e) {
+    threw = std::string(e.what()).find("unable to open") != std::string::npos;
+  }
+  CHECK(threw);
+
+  threw = false;
+  try {
+    rt::SequenceParser mq(write_file("bad.fastq", "@q\nACGT\n+\n!!\n"),
+                          rt::SeqFormat::kFastq);
+    mq.parse(0);
+  } catch (const rt::Error&) {
+    threw = true;
+  }
+  CHECK(threw);
+}
+
+// ---- window semantics ------------------------------------------------------
+// Reference: src/window.cpp — backbone passthrough (:68-71), layer position
+// validation, TGS low-coverage end trim + chimera guard (:125-146).
+
+static void test_window() {
+  const std::string bb = "ACGTACGTACGTACGTACGT";  // 20 bp
+  const std::string qual(bb.size(), '5');
+
+  // <3 sequences: backbone passthrough, POA did not run
+  auto w = rt::createWindow(7, 0, rt::WindowType::kTGS, bb.data(),
+                            bb.size(), qual.data(), qual.size());
+  rt::PoaAligner aligner(5, -4, -8);
+  CHECK(!w->generate_consensus(aligner, true));
+  CHECK_EQ(w->consensus, bb);
+
+  // invalid layer positions throw through the library error channel
+  bool threw = false;
+  try {
+    w->add_layer(bb.data(), 4, nullptr, 0, 10, 30);  // end > backbone
+  } catch (const rt::Error&) {
+    threw = true;
+  }
+  CHECK(threw);
+
+  // zero-length / empty-span layers are silently ignored
+  w->add_layer(bb.data(), 0, nullptr, 0, 0, 10);
+  w->add_layer(bb.data(), 4, nullptr, 0, 5, 5);
+  CHECK_EQ(w->sequences.size(), 1u);
+
+  // TGS trim: 4 perfect layers covering only [5, 15) -> consensus trimmed
+  // to the covered span (ends have backbone-only coverage 1 < avg 2)
+  auto t = rt::createWindow(7, 1, rt::WindowType::kTGS, bb.data(),
+                            bb.size(), qual.data(), qual.size());
+  const std::string mid = bb.substr(5, 10);
+  for (int i = 0; i < 4; ++i) {
+    t->add_layer(mid.data(), mid.size(), nullptr, 0, 5, 14);
+  }
+  CHECK(t->generate_consensus(aligner, true));
+  CHECK_EQ(t->consensus, mid);
+
+  // same window untrimmed (NGS type or trim=false keeps full span)
+  auto n = rt::createWindow(7, 2, rt::WindowType::kNGS, bb.data(),
+                            bb.size(), qual.data(), qual.size());
+  for (int i = 0; i < 4; ++i) {
+    n->add_layer(mid.data(), mid.size(), nullptr, 0, 5, 14);
+  }
+  CHECK(n->generate_consensus(aligner, true));
+  CHECK_EQ(n->consensus, bb);
+}
+
+// ---- thread pool -----------------------------------------------------------
+
+static void test_threadpool() {
+  rt::ThreadPool pool(4);
+  CHECK_EQ(pool.num_threads(), 4u);
+  // the calling (non-worker) thread gets the dedicated slot n
+  CHECK_EQ(pool.this_thread_index(), 4u);
+
+  std::atomic<uint32_t> sum{0};
+  std::set<uint32_t> seen;
+  std::mutex m;
+  std::vector<std::future<void>> futs;
+  for (int i = 0; i < 64; ++i) {
+    futs.push_back(pool.submit([&] {
+      sum.fetch_add(1);
+      std::lock_guard<std::mutex> lock(m);
+      seen.insert(pool.this_thread_index());
+    }));
+  }
+  for (auto& f : futs) {
+    f.get();
+  }
+  CHECK_EQ(sum.load(), 64u);
+  // every observed worker index is a real worker slot
+  for (uint32_t idx : seen) {
+    CHECK(idx < 4u);
+  }
+}
+
+// ---- sampler (rampler parity) ----------------------------------------------
+
+static void test_sampler() {
+  std::string fasta;
+  for (int i = 0; i < 4; ++i) {
+    fasta += ">s" + std::to_string(i) + "\n" + std::string(100, 'A') + "\n";
+  }
+  const std::string path = write_file("sample.fasta", fasta);
+
+  // split: record-granular ~200-byte chunks -> 2 files, all records kept
+  auto chunks = rt::sampler_split(path, 200, g_tmpdir);
+  CHECK_EQ(chunks.size(), 2u);
+  size_t records = 0;
+  for (const auto& c : chunks) {
+    rt::SequenceParser p(c, rt::SeqFormat::kFasta);
+    records += p.parse(0).size();
+  }
+  CHECK_EQ(records, 4u);
+
+  // subsample to ref_length*coverage = 200 bases -> 2 whole reads
+  const std::string sub = rt::sampler_subsample(path, 100, 2, g_tmpdir);
+  rt::SequenceParser p(sub, rt::SeqFormat::kFasta);
+  auto kept = p.parse(0);
+  uint64_t bases = 0;
+  for (const auto& s : kept) {
+    bases += s->data.size();
+  }
+  CHECK_EQ(bases, 200u);
+}
+
+// ---- pipeline end-to-end (pure native, no Python) --------------------------
+// A miniature of the λ golden flow (reference: test/racon_test.cpp): perfect
+// reads over a known truth must polish the draft back to the truth.
+
+static void test_pipeline() {
+  // deterministic pseudo-random truth
+  std::string truth;
+  uint64_t x = 0x9E3779B97F4A7C15ull;
+  for (int i = 0; i < 600; ++i) {
+    x ^= x << 13;
+    x ^= x >> 7;
+    x ^= x << 17;
+    truth += "ACGT"[x & 3];
+  }
+  // draft: truth with a substitution every 100 bases
+  std::string draft = truth;
+  for (size_t i = 50; i < draft.size(); i += 100) {
+    draft[i] = draft[i] == 'A' ? 'C' : 'A';
+  }
+
+  std::string reads, sam = "@HD\tVN:1.6\n@SQ\tSN:tgt\tLN:600\n";
+  for (int i = 0; i < 5; ++i) {
+    reads += ">r" + std::to_string(i) + "\n" + truth + "\n";
+    sam += "r" + std::to_string(i) + "\t0\ttgt\t1\t60\t600M\t*\t0\t0\t" +
+           truth + "\t*\n";
+  }
+  const std::string reads_p = write_file("e2e_reads.fasta", reads);
+  const std::string sam_p = write_file("e2e_ovl.sam", sam);
+  const std::string tgt_p = write_file("e2e_tgt.fasta", ">tgt\n" + draft + "\n");
+
+  rt::PipelineParams params;
+  params.window_length = 200;
+  params.match = 5;
+  params.mismatch = -4;
+  params.gap = -8;
+  rt::Pipeline pipe(reads_p, sam_p, tgt_p, params);
+  pipe.initialize();
+  CHECK_EQ(pipe.num_windows(), 3u);
+  pipe.consensus_cpu_all();
+  std::vector<std::pair<std::string, std::string>> out;
+  pipe.stitch(true, &out);
+  CHECK_EQ(out.size(), 1u);
+  CHECK_EQ(out[0].second, truth);
+  // provenance tags (reference: src/polisher.cpp:521-524)
+  CHECK(out[0].first.find("LN:i:600") != std::string::npos);
+  CHECK(out[0].first.find("RC:i:5") != std::string::npos);
+
+  // bad extension: reference-compatible library error, not an exit
+  bool threw = false;
+  try {
+    rt::Pipeline bad(g_tmpdir + "/x.txt", sam_p, tgt_p, params);
+  } catch (const rt::Error&) {
+    threw = true;
+  }
+  CHECK(threw);
+}
+
 int main() {
+  g_tmpdir = "/tmp/rt_test_" + std::to_string(::getpid());
+  ::mkdir(g_tmpdir.c_str(), 0755);
   test_sequence();
   test_align();
   test_overlap();
   test_poa();
+  test_parsers();
+  test_window();
+  test_threadpool();
+  test_sampler();
+  test_pipeline();
   if (g_failures) {
-    std::fprintf(stderr, "%d/%d checks FAILED\n", g_failures, g_checks);
+    // keep g_tmpdir for post-mortem
+    std::fprintf(stderr, "%d/%d checks FAILED (artifacts in %s)\n",
+                 g_failures, g_checks, g_tmpdir.c_str());
     return 1;
   }
+  std::system(("rm -rf '" + g_tmpdir + "'").c_str());
   std::printf("all %d checks passed\n", g_checks);
   return 0;
 }
