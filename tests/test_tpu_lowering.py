@@ -51,6 +51,23 @@ def test_lockstep_poa_kernel_lowers_to_tpu(window_length):
     assert len(exp.mlir_module_serialized) > 0
 
 
+def test_lockstep_poa_kernel_lowers_at_node_factor_4(monkeypatch):
+    """The hw_session factor4 step (RACON_TPU_NODE_FACTOR=4, admits the
+    repeat-dense windows factor 3 rejects — interpret evidence: 96/96 λ
+    windows device-served at ed 1282) must not be blocked by an
+    unlowerable geometry. v2 no longer fits VMEM at factor 4, so ls is
+    the only pallas tier there — all the more reason to gate it here."""
+    from racon_tpu.ops.poa_pallas_ls import build_lockstep_poa_kernel
+
+    monkeypatch.setenv("RACON_TPU_NODE_FACTOR", "4")
+    cfg = poa_driver.make_config(500, 8, 5, -4, -8)
+    assert cfg.max_nodes == 2048
+    assert poa_driver._fits_vmem(cfg, "ls"), "fit model rejects geometry"
+    fn = build_lockstep_poa_kernel(cfg, interpret=False)(8)
+    exp = _export_tpu(fn, _poa_args(cfg, 8, np.random.default_rng(0)))
+    assert len(exp.mlir_module_serialized) > 0
+
+
 def test_v2_poa_kernel_lowers_to_tpu():
     from racon_tpu.ops.poa_pallas import build_pallas_poa_kernel
 
