@@ -52,6 +52,10 @@ FRAGMENT = {
                "sample_reads.fastq.gz",
                {"fragment_correction": True, "match": 1, "mismatch": -1,
                 "gap": -1, "drop": False}),
+    "kf_mhap": ("sample_reads.fastq.gz", "sample_ava_overlaps.mhap.gz",
+                "sample_reads.fastq.gz",
+                {"fragment_correction": True, "match": 1, "mismatch": -1,
+                 "gap": -1, "drop": False}),
 }
 
 # host path (CPU SPOA-parity engine) — asserted in tests/test_golden.py;
@@ -68,6 +72,10 @@ HOST_FRAGMENT = {
     "kc": (40, 401215),            # reference: 40 / 401246
     "kf_fasta": (236, 1662904),    # reference: 236 / 1663982 (GPU 1663732)
     "kf_paf": (236, 1657837),      # reference: 236 / 1658216
+    # identical to kf_paf, as in the reference (its MHAP and PAF kF pins
+    # are both 1658216, racon_test.cpp:252-258,288-294): the MHAP ordinal
+    # transmutation resolves to the same overlaps bit-for-bit
+    "kf_mhap": (236, 1657837),     # reference: 236 / 1658216
 }
 
 # device path (fused Pallas kernel on a real TPU chip) — refreshed by
@@ -88,4 +96,5 @@ DEVICE_FRAGMENT = {
     "kc": None,
     "kf_fasta": None,
     "kf_paf": None,
+    "kf_mhap": None,
 }

@@ -18,7 +18,7 @@ session moves on. Priorities:
                     ls-vs-v2 tier decision (45 min)
   5. bench5       — RACON_TPU_BENCH_MBP=5 scale run (90 min)
   6. pin_<scenario> — one bounded pin_device_golden.py run per golden
-                    scenario (10 min each; 'pins' expands to all nine —
+                    scenario (10 min each; 'pins' expands to all ten —
                     a wedge mid-scenario cannot cost the remaining pins)
   7. aligner      — explicit RACON_TPU_DEVICE_ALIGNER=hirschberg bench
                     at 0.5 Mbp (45 min). Note the default `bench` step
@@ -175,7 +175,7 @@ def run_step(name, cmd, bound_s, extra_env):
 
 def main():
     wanted = sys.argv[1:] or [n for n, *_ in STEPS]
-    if "pins" in wanted:  # convenience alias for all nine pin steps
+    if "pins" in wanted:  # convenience alias for all ten pin steps
         i = wanted.index("pins")
         wanted[i:i + 1] = [n for n, *_ in STEPS if n.startswith("pin_")]
     unknown = set(wanted) - {n for n, *_ in STEPS}

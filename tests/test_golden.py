@@ -18,6 +18,7 @@ way the reference's own CUDA numbers differ from its CPU numbers):
   fragment kC count/bp          40/401215   40/401246
   fragment kF PAF count/bp      236/1657837 236/1658216
   fragment kF FASTA count/bp    236/1662904 236/1663982
+  fragment kF MHAP count/bp     236/1657837 236/1658216
 
 4 of 6 polish scenarios are at-or-better than the reference CPU; the two
 worse (w=1000, unit scores) are within 1.3%. The load-bearing semantic:
@@ -149,7 +150,7 @@ def test_device_path_golden(name, lambda_reference, monkeypatch):
     hardware branch needs RACON_TPU_HW_TESTS=1 (conftest otherwise forces
     the virtual CPU mesh). On the CPU backend (interpret mode) only the
     historical 'paf' scenario runs — within a small band of the host
-    golden; the other 8 would take hours in interpret mode on this box.
+    golden; the other 9 would take hours in interpret mode on this box.
     """
     if HW and not _on_tpu():
         # never let a wedged tunnel (JAX silently falls back to CPU) pass
@@ -210,3 +211,16 @@ def test_fragment_correction_kf_paf(lambda_reference):
     count, total = gs.HOST_FRAGMENT["kf_paf"]  # reference: 236 / 1658216
     assert len(res) == count
     assert sum(len(d) for _, d in res) == total
+
+
+@pytest.mark.skipif(not FULL, reason="very slow on 1-core host; "
+                    "set RACON_TPU_FULL_GOLDEN=1")
+def test_fragment_correction_kf_mhap(lambda_reference):
+    """kF with MHAP overlaps — the reference's 10th pinned scenario
+    (test/racon_test.cpp:288-294, 236/1,658,216 == its PAF kF): the MHAP
+    ordinal transmutation must resolve to the identical result."""
+    res = run_scenario("kf_mhap")
+    count, total = gs.HOST_FRAGMENT["kf_mhap"]
+    assert len(res) == count
+    assert sum(len(d) for _, d in res) == total
+    assert (count, total) == gs.HOST_FRAGMENT["kf_paf"]  # format parity
