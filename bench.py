@@ -317,6 +317,11 @@ def main():
         # failure device_healthy() exists to prevent
         from __graft_entry__ import _force_cpu
         _force_cpu(1)
+        # ...and rehearse the tier the healthy path actually ships:
+        # interpret-mode pallas (~2 s/window at 0.01 Mbp), not the XLA
+        # twin (~30 s/window on this box) — the twin is the degraded
+        # tier, not the flow under rehearsal
+        os.environ.setdefault("RACON_TPU_PALLAS", "1")
     paths = dataset()
 
     degraded = not device_healthy()

@@ -12,6 +12,7 @@ Mirrors the reference's CUDA polish orchestration
 
 from __future__ import annotations
 
+import functools
 import os
 import sys
 from collections import deque
@@ -395,11 +396,29 @@ def _fits_vmem(cfg, kind: str = "v2", budget_bytes: int = 14 << 20) -> bool:
 
 
 def _build_kernel(cfg, B, use_pallas, kind: str = "v2"):
+    """Memoization front for _build_kernel_cached: the XLA twin ignores
+    `kind`, so normalize it out of the key — a warm-up that degraded to
+    the twin under 'v2' must hit the same cache entry as a measured-run
+    request arriving via the 'ls' step-down (and as __graft_entry__'s
+    default-argument call)."""
+    if not use_pallas:
+        kind = "xla"
+    return _build_kernel_cached(cfg, B, use_pallas, kind)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_kernel_cached(cfg, B, use_pallas, kind):
     """Single- or multi-device kernel for a B-window batch.
 
     Multi-device: batch dim sharded over the 1-D `windows` mesh — the
     production analogue of the reference's multi-GPU batch striping
     (src/cuda/cudapolisher.cpp:228-240), with no collectives.
+
+    Memoized on the full geometry key: the warm-up's compiled kernel IS
+    the measured run's function object, so the in-process jit cache hits
+    even when the persistent disk cache can't serve (observed: AOT
+    entries compiled under different machine features fail to load and
+    silently recompile — minutes per geometry on the CPU twin).
     """
     import jax
 

@@ -62,6 +62,21 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip)
 
 
+@pytest.fixture(autouse=True)
+def _clear_kernel_cache():
+    """poa_driver._build_kernel is memoized (warm-up's compiled kernel is
+    the measured run's function object); tests that monkeypatch the
+    kernel builders to inject failures must not see another test's real
+    cached kernel, so drop the cache after every test."""
+    yield
+    try:
+        from racon_tpu.ops import poa_driver
+
+        poa_driver._build_kernel_cached.cache_clear()
+    except Exception:  # noqa: BLE001 — package may not be importable yet
+        pass
+
+
 _COMP = bytes.maketrans(b"ACGT", b"TGCA")
 
 
