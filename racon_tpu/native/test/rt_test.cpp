@@ -423,6 +423,51 @@ static void test_sampler() {
   CHECK_EQ(bases, 200u);
 }
 
+// ---- parser fuzz -----------------------------------------------------------
+// Seeded random byte soup through every parser: malformed input must
+// surface as rt::Error (or parse to something), never as a crash or
+// sanitizer report — this block rides the ASan and TSan CI builds.
+
+static void test_parser_fuzz() {
+  uint64_t x = 0x2545F4914F6CDD1Dull;
+  auto next = [&x]() {
+    x ^= x << 13;
+    x ^= x >> 7;
+    x ^= x << 17;
+    return x;
+  };
+  const char alphabet[] = ">@+ACGTacgt0123\t -\n\r!I~";
+  for (int round = 0; round < 40; ++round) {
+    std::string blob;
+    const size_t len = next() % 2048;
+    for (size_t i = 0; i < len; ++i) {
+      // bias toward structural characters, sprinkle raw bytes
+      blob += (next() % 8) ? alphabet[next() % (sizeof(alphabet) - 1)]
+                           : static_cast<char>(next() & 0xFF);
+    }
+    const std::string p = write_file("fuzz.bin", blob);
+    for (rt::SeqFormat f : {rt::SeqFormat::kFasta, rt::SeqFormat::kFastq}) {
+      try {
+        rt::SequenceParser sp(p, f);
+        auto out = sp.parse(0);
+        ++g_checks;  // parsed (possibly to zero records) without crashing
+      } catch (const rt::Error&) {
+        ++g_checks;  // clean library error is an acceptable outcome
+      }
+    }
+    for (rt::OvlFormat f :
+         {rt::OvlFormat::kMhap, rt::OvlFormat::kPaf, rt::OvlFormat::kSam}) {
+      try {
+        rt::OverlapParser op(p, f);
+        auto out = op.parse(0);
+        ++g_checks;
+      } catch (const rt::Error&) {
+        ++g_checks;
+      }
+    }
+  }
+}
+
 // ---- pipeline end-to-end (pure native, no Python) --------------------------
 // A miniature of the λ golden flow (reference: test/racon_test.cpp): perfect
 // reads over a known truth must polish the draft back to the truth.
@@ -458,6 +503,7 @@ static void test_pipeline() {
   params.match = 5;
   params.mismatch = -4;
   params.gap = -8;
+  params.num_threads = 4;  // pooled paths under the sanitizer builds
   rt::Pipeline pipe(reads_p, sam_p, tgt_p, params);
   pipe.initialize();
   CHECK_EQ(pipe.num_windows(), 3u);
@@ -491,6 +537,7 @@ int main() {
   test_window();
   test_threadpool();
   test_sampler();
+  test_parser_fuzz();
   test_pipeline();
   if (g_failures) {
     // keep g_tmpdir for post-mortem
