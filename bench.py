@@ -356,14 +356,25 @@ def main():
         print(f"[bench] cpu: {bp_cpu} bp in {dt_cpu:.1f}s", file=sys.stderr)
         return
 
-    tier = pallas_compiles()
-    pallas_ok = tier is not None
-    if not pallas_ok:
-        # Bound the blast radius: the XLA device kernel is the degraded
-        # tier; measure it honestly rather than hanging on Mosaic.
-        os.environ["RACON_TPU_PALLAS"] = "0"
+    pallas_disabled = os.environ.get("RACON_TPU_PALLAS") == "0"
+    if pallas_disabled:
+        # Explicit XLA-tier measurement (hw_session bench_sam_xla64):
+        # skip the Mosaic probes entirely — they'd compile kernels this
+        # run has disabled, and a Mosaic hang would starve the one step
+        # that doesn't need pallas at all — and label the result as the
+        # XLA tier so the durable log keeps the three tiers apart.
+        tier = None
+        pallas_ok = False
     else:
-        os.environ["RACON_TPU_POA_KERNEL"] = tier
+        tier = pallas_compiles()
+        pallas_ok = tier is not None
+        if not pallas_ok:
+            # Bound the blast radius: the XLA device kernel is the
+            # degraded tier; measure it honestly rather than hanging on
+            # Mosaic.
+            os.environ["RACON_TPU_PALLAS"] = "0"
+        else:
+            os.environ["RACON_TPU_POA_KERNEL"] = tier
     aligner = aligner_compiles()
     if aligner == "host":
         # probe failed or hung: pin the host aligner so the measured run
@@ -390,8 +401,12 @@ def main():
 
     mbps_tpu = bp_tpu / dt_tpu / 1e6
     mbps_cpu = bp_cpu / dt_cpu / 1e6
-    kernel_tag = (f" [pallas {tier}]" if pallas_ok
-                  else " [XLA kernel: pallas compile failed]")
+    if pallas_disabled:
+        kernel_tag = " [XLA kernel: RACON_TPU_PALLAS=0]"
+    elif pallas_ok:
+        kernel_tag = f" [pallas {tier}]"
+    else:
+        kernel_tag = " [XLA kernel: pallas compile failed]"
     if _forced_device():
         # the one-line JSON is the bench's documented output: a CPU dry
         # run must be unmistakable there too, not only in the sidecar log

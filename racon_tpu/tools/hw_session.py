@@ -16,6 +16,10 @@ session moves on. Priorities:
                     consensus kernel, ls tier (45 min)
   4. bench_sam_v2 — same with RACON_TPU_POA_KERNEL=v2: the on-chip
                     ls-vs-v2 tier decision (45 min)
+  4b. bench_sam_xla64 — same through the vmapped XLA kernel at
+                    RACON_TPU_BATCH_WINDOWS=64: the cost model's
+                    bandwidth-bound alternative to both hand kernels
+                    (45 min)
   5. bench5       — RACON_TPU_BENCH_MBP=5 scale run (90 min)
   6. pin_<scenario> — one bounded pin_device_golden.py run per golden
                     scenario (10 min each; 'pins' expands to all ten —
@@ -71,6 +75,13 @@ STEPS = [
      {"RACON_TPU_BENCH_INPUT": "sam"}),
     ("bench_sam_v2", [sys.executable, "bench.py"], 2700,
      {"RACON_TPU_BENCH_INPUT": "sam", "RACON_TPU_POA_KERNEL": "v2"}),
+    # the third consensus tier: the vmapped XLA kernel at a wide batch —
+    # the cost model's "decisive alternative" (if XLA lowers the graph
+    # gathers well it is bandwidth-bound rather than latency-bound and
+    # could beat both hand kernels; docs/benchmarks.md cost-model notes)
+    ("bench_sam_xla64", [sys.executable, "bench.py"], 2700,
+     {"RACON_TPU_BENCH_INPUT": "sam", "RACON_TPU_PALLAS": "0",
+      "RACON_TPU_BATCH_WINDOWS": "64"}),
     ("bench5", [sys.executable, "bench.py"], 5400,
      {"RACON_TPU_BENCH_MBP": "5"}),
     ("aligner", [sys.executable, "bench.py"], 2700,
