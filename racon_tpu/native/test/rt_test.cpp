@@ -387,10 +387,14 @@ static void test_threadpool() {
     f.get();
   }
   CHECK_EQ(sum.load(), 64u);
-  // every observed worker index is a real worker slot
+  // every observed worker index is a real worker slot (one aggregate
+  // CHECK: how many distinct workers ran is scheduling-dependent, and a
+  // per-element loop would make the total check count vary by build)
+  uint32_t max_idx = 0;
   for (uint32_t idx : seen) {
-    CHECK(idx < 4u);
+    max_idx = idx > max_idx ? idx : max_idx;
   }
+  CHECK(!seen.empty() && max_idx < 4u);
 }
 
 // ---- sampler (rampler parity) ----------------------------------------------
