@@ -25,6 +25,12 @@ Costs are unit (edit distance), matching the reference's edlib NW config.
 In-band-only contract as the reference's banded CUDA aligner; pairs whose
 optimal path escapes the band are detected (INF at a midpoint) and left to
 the host engine.
+
+Multi-device: kernel batches whose size divides the mesh shard over the
+1-D `windows` axis (shard_map, leading batch dim, zero collectives) —
+the same batch striping as the consensus path and the analogue of the
+reference's per-GPU aligner batches
+(/root/reference/src/cuda/cudapolisher.cpp:96-114).
 """
 
 from __future__ import annotations
@@ -57,6 +63,17 @@ def band_for(n: int, m: int, band_hint: int = 0) -> int:
 
 def _round_up(x, m):
     return (x + m - 1) // m * m
+
+
+def _shard_over_mesh(build_local, batch, n_in, n_out):
+    """Batch-stripe a kernel build over the `windows` mesh (the shared
+    parallel.mesh.shard_batch_build wrap — same no-collective striping
+    as the consensus path; reference analogue: per-GPU aligner batches,
+    /root/reference/src/cuda/cudapolisher.cpp:96-114). None = batch
+    doesn't divide; caller uses the single-device jit."""
+    from ..parallel.mesh import shard_batch_build
+
+    return shard_batch_build(build_local, batch, n_in, n_out)
 
 
 # ---------------------------------------------------------------------------
@@ -188,17 +205,21 @@ def _build_edge_kernel(rcap: int, K: int, backward: bool,
             interpret=interpret,
         )
 
-    @functools.lru_cache(maxsize=8)
-    def jitted(batch):
-        call = make(batch)
+    def plain(b):
+        call = make(b)
 
         def fn(scal, q, t):
-            out = call(scal.reshape(batch, 1, 4),
-                       q.reshape(batch, 1, rcap),
-                       t.reshape(batch, 1, TCAP))
-            return out.reshape(batch, K)
+            out = call(scal.reshape(b, 1, 4),
+                       q.reshape(b, 1, rcap),
+                       t.reshape(b, 1, TCAP))
+            return out.reshape(b, K)
 
-        return jax.jit(fn)
+        return fn
+
+    @functools.lru_cache(maxsize=8)
+    def jitted(batch):
+        sharded = _shard_over_mesh(plain, batch, 3, 1)
+        return sharded if sharded is not None else jax.jit(plain(batch))
 
     return jitted
 
@@ -309,21 +330,25 @@ def _build_base_kernel(K: int, interpret: bool = False):
             interpret=interpret,
         )
 
-    @functools.lru_cache(maxsize=8)
-    def jitted(batch):
-        call = make(batch)
-        QCAP = _round_up(RB, 128)
+    QCAP = _round_up(RB, 128)
+
+    def plain(b):
+        call = make(b)
 
         def fn(scal, q, t):
-            ops, cnt, ok = call(scal.reshape(batch, 1, 4),
-                                q.reshape(batch, 1, QCAP),
-                                t.reshape(batch, 1, TCAP))
-            return (ops.reshape(batch, OPS), cnt.reshape(batch),
-                    ok.reshape(batch))
+            ops, cnt, ok = call(scal.reshape(b, 1, 4),
+                                q.reshape(b, 1, QCAP),
+                                t.reshape(b, 1, TCAP))
+            return (ops.reshape(b, OPS), cnt.reshape(b), ok.reshape(b))
 
-        return jax.jit(fn)
+        return fn
 
-    return jitted, OPS, _round_up(RB, 128), TCAP
+    @functools.lru_cache(maxsize=8)
+    def jitted(batch):
+        sharded = _shard_over_mesh(plain, batch, 3, 3)
+        return sharded if sharded is not None else jax.jit(plain(batch))
+
+    return jitted, OPS, QCAP, TCAP
 
 
 # ---------------------------------------------------------------------------

@@ -433,16 +433,11 @@ def _build_kernel_cached(cfg, B, use_pallas, kind):
         interp = jax.devices()[0].platform != "tpu"
         if n_dev == 1:
             return build(cfg, interpret=interp)(B)
-        from jax.sharding import PartitionSpec as P
-
-        from ..parallel.mesh import AXIS, device_mesh
-        mesh = device_mesh()
-        local = build(cfg, interpret=interp)(B // n_dev)
-        spec = P(AXIS)
-        return jax.jit(jax.shard_map(
-            lambda *args: local(*args), mesh=mesh,
-            in_specs=(spec,) * 9, out_specs=(spec,) * 5,
-            check_vma=False))
+        from ..parallel.mesh import shard_batch_build
+        sharded = shard_batch_build(
+            lambda b: build(cfg, interpret=interp)(b), B, 9, 5)
+        assert sharded is not None, (B, n_dev)  # _device_batch divides B
+        return sharded
     kernel = poa.build_poa_kernel(cfg)
     if n_dev == 1:
         return kernel

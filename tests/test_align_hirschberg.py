@@ -138,6 +138,49 @@ def test_polish_with_hirschberg_engine(tmp_path, monkeypatch):
     assert native.edit_distance(dev[0][1].encode(), truth.encode()) <= 8
 
 
+def test_sharded_batches_over_mesh_exact(monkeypatch):
+    """A homogeneous batch that divides the 8-device mesh runs the edge
+    and base kernels under shard_map (the consensus path's no-collective
+    batch striping) and must emit the same exact-optimal paths as the
+    single-device build."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the suite's 8-virtual-device mesh")
+
+    shard_calls = []
+    real = align_pallas._shard_over_mesh
+
+    def recording(build_local, batch, n_in, n_out):
+        out = real(build_local, batch, n_in, n_out)
+        shard_calls.append((batch, out is not None))
+        return out
+
+    monkeypatch.setattr(align_pallas, "_shard_over_mesh", recording)
+    # fresh builders so cached single-device jits can't bypass the recorder
+    align_pallas._build_edge_kernel.cache_clear()
+    align_pallas._build_base_kernel.cache_clear()
+
+    rng = random.Random(23)
+    pairs = []
+    for _ in range(8):  # homogeneous bucket: same lengths -> same (rcap, K)
+        q = _rand(rng, 700)
+        t = mutate(q, 0.06, rng)
+        pairs.append((q, t))
+    enc = [(encode(np.frombuffer(q, np.uint8)).astype(np.int32),
+            encode(np.frombuffer(t, np.uint8)).astype(np.int32))
+           for q, t in pairs]
+    results = align_pallas.align_pairs(enc, interpret=True)
+
+    assert any(ok for _, ok in shard_calls), shard_calls  # mesh engaged
+    for (q, t), ops in zip(pairs, results):
+        assert ops is not None
+        assert path_cost(ops, q, t) == native.edit_distance(q, t)
+
+    align_pallas._build_edge_kernel.cache_clear()
+    align_pallas._build_base_kernel.cache_clear()
+
+
 def test_engine_auto_defaults_to_hirschberg_on_tpu(monkeypatch):
     """With no env override, the production tier is the Hirschberg engine
     on a TPU backend and the host Myers aligner elsewhere — the same
