@@ -25,9 +25,24 @@ import time
 
 MBP = float(os.environ.get("RACON_TPU_BENCH_MBP", "0.5"))
 INPUT = os.environ.get("RACON_TPU_BENCH_INPUT", "paf")
+# 'ont' (default): ~8 kb reads at ~11% error — BASELINE config 2's shape.
+# 'sr': 150 bp reads at ~1% error — the short-read (chr20-class,
+# BASELINE config 4) regime: NGS-type windows (no trim), ~130 shallow
+# layers per window instead of ~30 long ones.
+PROFILE = os.environ.get("RACON_TPU_BENCH_PROFILE", "ont")
+PROFILES = {
+    "ont": dict(mean_read=8000, sub=0.05, ins=0.03, dele=0.03),
+    "sr": dict(mean_read=150, sub=0.008, ins=0.001, dele=0.001),
+}
 COVERAGE = 30
 ARGS = dict(window_length=500, quality_threshold=10.0, error_threshold=0.3,
             match=5, mismatch=-4, gap=-8, num_threads=1)
+
+if PROFILE not in PROFILES:
+    raise SystemExit(f"RACON_TPU_BENCH_PROFILE must be one of "
+                     f"{sorted(PROFILES)}, got {PROFILE!r}")
+_WORKLOAD = ("synthetic ONT" if PROFILE == "ont"
+             else "synthetic short-read")
 
 
 def dataset(mbp: float = MBP):
@@ -37,16 +52,21 @@ def dataset(mbp: float = MBP):
 
     from racon_tpu.tools import simulate
 
-    # Cache keyed by size/coverage AND the generator source, so simulator
+    # Cache keyed by size/coverage/profile (name AND parameter values —
+    # tuning a PROFILES entry must not silently reuse a dataset generated
+    # with the old parameters) plus the generator source, so simulator
     # changes invalidate stale data; built in a temp dir and renamed into
     # place so concurrent bench runs never see half-written files.
     src_tag = hashlib.sha256(
-        inspect.getsource(simulate).encode()).hexdigest()[:12]
-    outdir = f"/tmp/racon_tpu_bench_{mbp}mbp_{COVERAGE}x_{src_tag}"
+        (inspect.getsource(simulate) +
+         repr(sorted(PROFILES[PROFILE].items()))).encode()).hexdigest()[:12]
+    ptag = "" if PROFILE == "ont" else f"_{PROFILE}"
+    outdir = f"/tmp/racon_tpu_bench_{mbp}mbp_{COVERAGE}x{ptag}_{src_tag}"
     if not os.path.isdir(outdir):
         tmpdir = outdir + f".tmp{os.getpid()}"
         shutil.rmtree(tmpdir, ignore_errors=True)
-        paths = simulate.generate(tmpdir, mbp=mbp, coverage=COVERAGE)
+        paths = simulate.generate(tmpdir, mbp=mbp, coverage=COVERAGE,
+                                  **PROFILES[PROFILE])
         try:
             os.rename(tmpdir, outdir)
         except OSError:
@@ -346,7 +366,7 @@ def main():
         bp_cpu, dt_cpu = run("cpu", paths)
         mbps_cpu = bp_cpu / dt_cpu / 1e6
         print(json.dumps({
-            "metric": f"polished Mbp/sec (synthetic ONT {MBP} Mbp "
+            "metric": f"polished Mbp/sec ({_WORKLOAD} {MBP} Mbp "
                       f"{COVERAGE}x, {INPUT.upper()}, w=500, end-to-end) "
                       f"[TPU UNREACHABLE: host path only{note}]",
             "value": round(mbps_cpu, 4),
@@ -412,7 +432,8 @@ def main():
         # run must be unmistakable there too, not only in the sidecar log
         kernel_tag += " [FORCED DRY-RUN: not device evidence]"
     log_device_measurement({
-        "mbp": MBP, "input": INPUT, "value": round(mbps_tpu, 4),
+        "mbp": MBP, "input": INPUT, "profile": PROFILE,
+        "value": round(mbps_tpu, 4),
         "vs_baseline": round(mbps_tpu / mbps_cpu, 3),
         "pallas": pallas_ok, "kernel": tier or "xla",
         "aligner": _aligner_log_value(aligner),
@@ -420,7 +441,7 @@ def main():
         "tpu_s": round(dt_tpu, 1), "cpu_s": round(dt_cpu, 1),
     })
     print(json.dumps({
-        "metric": f"polished Mbp/sec (synthetic ONT {MBP} Mbp {COVERAGE}x, "
+        "metric": f"polished Mbp/sec ({_WORKLOAD} {MBP} Mbp {COVERAGE}x, "
                   f"{INPUT.upper()}, w=500, end-to-end){kernel_tag}",
         "value": round(mbps_tpu, 4),
         "unit": "Mbp/s",

@@ -20,6 +20,9 @@ session moves on. Priorities:
                     RACON_TPU_BATCH_WINDOWS=64: the cost model's
                     bandwidth-bound alternative to both hand kernels
                     (45 min)
+  4c. bench_sam_sr — consensus bench on the short-read profile
+                    (150 bp @ ~1% error, BASELINE config-4 regime:
+                    NGS windows, deep shallow layers) (45 min)
   5. bench5       — RACON_TPU_BENCH_MBP=5 scale run (90 min)
   6. pin_<scenario> — one bounded pin_device_golden.py run per golden
                     scenario (10 min each; 'pins' expands to all ten —
@@ -82,6 +85,10 @@ STEPS = [
     ("bench_sam_xla64", [sys.executable, "bench.py"], 2700,
      {"RACON_TPU_BENCH_INPUT": "sam", "RACON_TPU_PALLAS": "0",
       "RACON_TPU_BATCH_WINDOWS": "64"}),
+    # short-read regime (BASELINE config 4's shape): 150 bp reads at ~1%
+    # error — NGS windows, ~130 shallow layers/window vs ONT's ~30 long
+    ("bench_sam_sr", [sys.executable, "bench.py"], 2700,
+     {"RACON_TPU_BENCH_INPUT": "sam", "RACON_TPU_BENCH_PROFILE": "sr"}),
     ("bench5", [sys.executable, "bench.py"], 5400,
      {"RACON_TPU_BENCH_MBP": "5"}),
     ("aligner", [sys.executable, "bench.py"], 2700,

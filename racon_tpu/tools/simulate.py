@@ -62,7 +62,11 @@ def _mutate_reads(genome: np.ndarray, rng, n_reads: int, mean_len: int,
     for a, b in zip(b"ACGT", b"TGCA"):
         comp[a] = b
     for _ in range(n_reads):
-        length = int(np.clip(rng.gamma(4.0, mean_len / 4.0), 500, 40000))
+        # floor at min(500, mean): the 500 floor suits long-read gammas;
+        # short-read profiles (mean 150) would otherwise clamp every
+        # read up to 500
+        lo = min(500, int(mean_len))
+        length = int(np.clip(rng.gamma(4.0, mean_len / 4.0), lo, 40000))
         length = min(length, g_len)
         start = int(rng.integers(0, g_len - length + 1))
         seg = genome[start:start + length]

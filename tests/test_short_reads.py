@@ -9,7 +9,36 @@ import random
 
 import racon_tpu
 from racon_tpu import native
-from racon_tpu.tools import preprocess
+from racon_tpu.tools import preprocess, simulate
+
+
+def test_bench_sr_profile_dataset_polishes(tmp_path):
+    """The bench's short-read profile (150 bp @ ~1% error — the
+    hw_session bench_sam_sr workload) must produce a dataset the host
+    pipeline actually polishes: reads are short-read-sized, windows are
+    NGS-class, and the polished contig lands closer to the genome than
+    the draft started."""
+    paths = simulate.generate(str(tmp_path / "sr"), mbp=0.02, coverage=30,
+                              mean_read=150, sub=0.008, ins=0.001,
+                              dele=0.001)
+    with open(paths["reads"]) as f:
+        lens = [len(line.strip()) for i, line in enumerate(f) if i % 4 == 1]
+    assert sum(lens) / len(lens) < 300, "not a short-read profile"
+
+    p = racon_tpu.create_polisher(paths["reads"], paths["overlaps_sam"],
+                                  paths["draft"], backend="cpu",
+                                  window_length=500,
+                                  quality_threshold=10.0,
+                                  error_threshold=0.3, match=5,
+                                  mismatch=-4, gap=-8, num_threads=1)
+    p.initialize()
+    res = p.polish(True)
+    assert len(res) == 1
+    genome = open(paths["genome"]).read().split("\n", 1)[1].replace("\n", "")
+    draft = open(paths["draft"]).read().split("\n", 1)[1].replace("\n", "")
+    ed_draft = native.edit_distance(draft.encode(), genome.encode())
+    ed_pol = native.edit_distance(res[0][1].encode(), genome.encode())
+    assert ed_pol < ed_draft / 4, (ed_pol, ed_draft)
 
 
 def make_dataset(tmp_path, rng, genome_len=2000, read_len=150, coverage=20):
