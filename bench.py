@@ -326,7 +326,10 @@ def run(backend: str, paths):
     res = p.polish(True)
     dt = time.time() - t0
     polished_bp = sum(len(d) for _, d in res)
-    return polished_bp, dt
+    # compact serving-mix report (who served what, fallback causes) —
+    # attached to the bench JSON/log so a silently degraded tier can't
+    # masquerade as a device measurement
+    return polished_bp, dt, p.report.summary()
 
 
 def main():
@@ -363,7 +366,7 @@ def main():
                     f"({tier}): {prev.get('value', '?')} Mbp/s, vs_baseline "
                     f"{prev.get('vs_baseline', '?')} on "
                     f"{prev.get('mbp', '?')} Mbp")
-        bp_cpu, dt_cpu = run("cpu", paths)
+        bp_cpu, dt_cpu, _ = run("cpu", paths)
         mbps_cpu = bp_cpu / dt_cpu / 1e6
         print(json.dumps({
             "metric": f"polished Mbp/sec ({_WORKLOAD} {MBP} Mbp "
@@ -416,8 +419,8 @@ def main():
                                ARGS["mismatch"], ARGS["gap"])
     run("tpu", dataset(mbp=min(MBP, 0.05)))
 
-    bp_tpu, dt_tpu = run("tpu", paths)
-    bp_cpu, dt_cpu = run("cpu", paths)
+    bp_tpu, dt_tpu, rep_tpu = run("tpu", paths)
+    bp_cpu, dt_cpu, _ = run("cpu", paths)
 
     mbps_tpu = bp_tpu / dt_tpu / 1e6
     mbps_cpu = bp_cpu / dt_cpu / 1e6
@@ -439,6 +442,7 @@ def main():
         "aligner": _aligner_log_value(aligner),
         "node_factor": int(os.environ.get("RACON_TPU_NODE_FACTOR", "3")),
         "tpu_s": round(dt_tpu, 1), "cpu_s": round(dt_cpu, 1),
+        "report": rep_tpu,
     })
     print(json.dumps({
         "metric": f"polished Mbp/sec ({_WORKLOAD} {MBP} Mbp {COVERAGE}x, "
@@ -446,6 +450,7 @@ def main():
         "value": round(mbps_tpu, 4),
         "unit": "Mbp/s",
         "vs_baseline": round(mbps_tpu / mbps_cpu, 3),
+        "report": rep_tpu,
     }))
     print(f"[bench] tpu: {bp_tpu} bp in {dt_tpu:.1f}s | "
           f"cpu: {bp_cpu} bp in {dt_cpu:.1f}s", file=sys.stderr)

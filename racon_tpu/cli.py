@@ -55,6 +55,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--tpu", action="store_true",
                    help="run the accelerated path (batched alignment + POA "
                    "on the JAX backend, host fallback for rejected work)")
+    p.add_argument("--report", metavar="PATH", default=None,
+                   help="write a machine-readable JSON run report (per-phase "
+                   "serving tiers, fallback causes, retries, quarantined "
+                   "windows, wall time per tier) to PATH")
     p.add_argument("--version", action="version", version=__version__)
     return p
 
@@ -63,12 +67,21 @@ def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
 
     from .native import NativeError
+    from .resilience import faults
+
+    # Validate the fault-injection spec up front (same contract as the
+    # file-extension checks: single-line error, exit 1) — a malformed
+    # RACON_TPU_FAULT must not surface as a mid-run traceback.
+    try:
+        faults.validate_env()
+    except ValueError as e:
+        print(e, file=sys.stderr)
+        return 1
 
     if args.tpu:
-        # Validate device-path env config up front (same contract as the
-        # file-extension checks: single-line error, exit 1) — a broad
-        # ValueError catch around the whole run would also swallow real
-        # bugs' tracebacks.
+        # Validate device-path env config up front — a broad ValueError
+        # catch around the whole run would also swallow real bugs'
+        # tracebacks.
         from .ops.poa_driver import _kernel_kind
         try:
             _kernel_kind()
@@ -90,6 +103,8 @@ def main(argv=None) -> int:
         polisher.initialize()
         for name, data in polisher.polish(not args.include_unpolished):
             sys.stdout.write(f">{name}\n{data}\n")
+        if args.report:
+            polisher.report.write(args.report)
     except NativeError as e:
         # the reference binary surfaces runtime errors as the what() text
         # and a non-zero exit (src/main.cpp catches nothing); a Python

@@ -127,38 +127,79 @@ def build_align_kernel(cap: int, band: int):
     return jax.jit(jax.vmap(one))
 
 
-def run_jobs(pipeline, jobs, batch: int = 16) -> int:
+def run_jobs(pipeline, jobs, batch: int = 16, report=None) -> int:
     """Align the given pipeline jobs on device; install CIGARs.
-    Returns how many alignments the device served."""
+    Returns how many alignments the device served.
+
+    Jobs bucket by padded length (lengths only — bases are materialized
+    per chunk inside the device attempt), and every chunk runs through
+    the degradation lattice: bounded retry, then bisection so a poisoned
+    job is quarantined to the host while the rest of the chunk stays on
+    the device.  A chunk-independent failure stops the engine; the served
+    count stays accurate for whatever was already installed."""
+    import sys
+
+    from ..resilience import faults
+    from ..resilience import lattice as rl
+
     served = 0
-    # Group by bucket.
+    if hasattr(pipeline, "align_job_lengths"):
+        lengths = pipeline.align_job_lengths()
+        maxlen = {j: int(max(lengths[j, 0], lengths[j, 1])) for j in jobs}
+    else:  # duck-typed pipelines without the lengths table
+        maxlen = {}
+        for job in jobs:
+            qa, ta = pipeline.align_job(job)
+            maxlen[job] = max(len(qa), len(ta))
+    # Group by bucket (lengths only, no bases copied yet).
     grouped = {}
     for job in jobs:
-        qa, ta = pipeline.align_job(job)
-        cap, band = _bucket_for(max(len(qa), len(ta)))
-        grouped.setdefault((cap, band), []).append((job, qa, ta))
+        cap, band = _bucket_for(maxlen[job])
+        grouped.setdefault((cap, band), []).append(job)
 
     for (cap, band), items in sorted(grouped.items()):
         kernel = build_align_kernel(cap, band)
         for off in range(0, len(items), batch):
             chunk = items[off:off + batch]
-            B = len(chunk)
-            q = np.zeros((B, cap), dtype=np.uint8)
-            t = np.zeros((B, cap), dtype=np.uint8)
-            n = np.zeros(B, dtype=np.int32)
-            m = np.zeros(B, dtype=np.int32)
-            for bi, (job, qa, ta) in enumerate(chunk):
-                q[bi, :len(qa)] = encode(qa)
-                t[bi, :len(ta)] = encode(ta)
-                n[bi] = len(qa)
-                m[bi] = len(ta)
-            ops, cnt, ok = (np.asarray(x) for x in kernel(q, t, n, m))
-            for bi, (job, qa, ta) in enumerate(chunk):
-                if not ok[bi]:
-                    continue  # host will align it
-                cigar = ops_to_cigar(ops[bi, :cnt[bi]][::-1])
-                pipeline.set_job_cigar(job, cigar)
-                served += 1
+
+            def attempt(sub, _kernel=kernel, _cap=cap):
+                faults.check("align.run", sub)
+                B = len(sub)
+                q = np.zeros((B, _cap), dtype=np.uint8)
+                t = np.zeros((B, _cap), dtype=np.uint8)
+                n = np.zeros(B, dtype=np.int32)
+                m = np.zeros(B, dtype=np.int32)
+                for bi, job in enumerate(sub):
+                    qa, ta = pipeline.align_job(job)
+                    q[bi, :len(qa)] = encode(qa)
+                    t[bi, :len(ta)] = encode(ta)
+                    n[bi] = len(qa)
+                    m[bi] = len(ta)
+                return tuple(np.asarray(x) for x in _kernel(q, t, n, m))
+
+            try:
+                pairs_results, quarantined = rl.serve_with_bisect(
+                    chunk, attempt, tier="xla", report=report)
+                for sub, (ops, cnt, ok) in pairs_results:
+                    for bi, job in enumerate(sub):
+                        if not ok[bi]:
+                            continue  # host will align it
+                        cigar = ops_to_cigar(ops[bi, :cnt[bi]][::-1])
+                        pipeline.set_job_cigar(job, cigar)
+                        served += 1
+                        if report is not None:
+                            report.record_served("xla")
+                for job, exc in quarantined:
+                    if report is not None:
+                        report.record_quarantine(job, exc)
+            except Exception as e:  # noqa: BLE001 — lattice boundary
+                cause = e.cause if isinstance(e, rl.TierDead) else e
+                print(f"[racon_tpu::align] WARNING: xla aligner failed "
+                      f"({type(cause).__name__}: {cause}); remaining jobs "
+                      f"fall back to the host aligner", file=sys.stderr)
+                if report is not None:
+                    report.record_degrade("xla", "host", cause)
+                return served
     return served
 
 

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 
 
 def _on_tpu() -> bool:
@@ -46,12 +47,22 @@ def _engine() -> str:
 
 def run_alignment_phase(pipeline, progress: bool = False) -> dict:
     """Device alignment for every eligible CIGAR-less overlap; host for
-    the rest. Any device-engine failure (Mosaic compile/runtime) degrades
-    to the host aligner for the remaining jobs — the phase-1 analogue of
-    the consensus driver's kernel-tier lattice; already-installed CIGARs
-    are kept."""
-    stats = {"device": 0, "host": 0}
+    the rest.  Device failures run through the degradation lattice inside
+    the engines' run_jobs (per-cohort retry, bisection-quarantine, engine
+    death -> host for the remainder); already-installed CIGARs are kept
+    and the served count survives a mid-phase engine failure.
+
+    Returns stats {device:…, host:…, report: PhaseReport} — the report's
+    per-tier served counts sum to the job count, clean or
+    fault-injected."""
+    from ..resilience import faults
+    from ..resilience import lattice as rl
+    from ..resilience.report import PhaseReport
+
+    report = PhaseReport("alignment", rl.ALIGN_TIERS)
+    stats = {"device": 0, "host": 0, "report": report}
     n = pipeline.num_align_jobs()
+    report.total = n
     if n:
         # engine resolution inside the guard AND the try: with no align
         # jobs (SAM input) phase 1 must not touch the JAX backend at all,
@@ -63,6 +74,7 @@ def run_alignment_phase(pipeline, progress: bool = False) -> dict:
             if engine == "host":
                 pass
             elif engine == "hirschberg":
+                faults.check("align.compile")
                 from . import align_pallas
 
                 lengths = pipeline.align_job_lengths()
@@ -70,8 +82,10 @@ def run_alignment_phase(pipeline, progress: bool = False) -> dict:
                         if align_pallas.band_for(int(lengths[i, 0]),
                                                  int(lengths[i, 1])) > 0]
                 if jobs:
-                    stats["device"] = align_pallas.run_jobs(pipeline, jobs)
+                    stats["device"] = align_pallas.run_jobs(
+                        pipeline, jobs, report=report)
             else:
+                faults.check("align.compile")
                 from . import align
 
                 lengths = pipeline.align_job_lengths()
@@ -79,14 +93,20 @@ def run_alignment_phase(pipeline, progress: bool = False) -> dict:
                         if align.device_eligible(lengths[i, 0],
                                                  lengths[i, 1])]
                 if jobs:
-                    stats["device"] = align.run_jobs(pipeline, jobs)
-        except Exception as e:  # noqa: BLE001
+                    stats["device"] = align.run_jobs(
+                        pipeline, jobs, report=report)
+        except Exception as e:  # noqa: BLE001 — engine/backend init
             print(f"[racon_tpu::align] WARNING: device aligner "
                   f"'{engine}' failed ({type(e).__name__}: {e}); "
                   f"finishing the alignment phase on the host",
                   file=sys.stderr)
+            report.record_failure(engine, e)
+            report.record_degrade(engine, "host", e)
     # Host finishes everything still CIGAR-less (device-rejected or
     # ineligible).
+    t0 = time.perf_counter()
     pipeline.align_jobs_cpu()
+    report.add_wall("host", time.perf_counter() - t0)
     stats["host"] = n - stats["device"]
+    report.record_served("host", stats["host"])
     return stats

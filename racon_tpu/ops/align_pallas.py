@@ -534,33 +534,64 @@ def _solve_base(pairs, tasks, bands, segments, failed, interpret):
 from .align import ops_to_cigar  # same 0=M/1=I/2=D convention
 
 
-def run_jobs(pipeline, jobs, cohort: int = 64) -> int:
+def cohort_size(default: int = 64) -> int:
+    """Jobs materialized per device cohort (RACON_TPU_ALIGN_COHORT)."""
+    return max(1, int(os.environ.get("RACON_TPU_ALIGN_COHORT", default)))
+
+
+def run_jobs(pipeline, jobs, cohort: int = None, report=None) -> int:
     """Align pipeline jobs with the Hirschberg engine; install CIGARs.
     Returns how many the device served (band escapes fall to host).
     Jobs are materialized per cohort so host memory stays O(cohort), not
-    O(total bases). A kernel failure (Mosaic compile/runtime) stops the
-    engine and leaves the remaining jobs CIGAR-less for the host — the
-    served count stays accurate for the cohorts already installed."""
+    O(total bases).
+
+    Each cohort runs through the degradation lattice: bounded retry, then
+    bisection (a poisoned job is quarantined to the host while the rest
+    of the cohort stays on the device).  A cohort-independent failure
+    stops the engine and leaves the remaining jobs CIGAR-less for the
+    host — the served count stays accurate for the cohorts already
+    installed, whatever point the engine died at."""
     import sys
 
+    from ..resilience import faults
+    from ..resilience import lattice as rl
+
+    if cohort is None:
+        cohort = cohort_size()
     served = 0
     for off in range(0, len(jobs), cohort):
         group = jobs[off:off + cohort]
-        pairs = []
-        for job in group:
-            qa, ta = pipeline.align_job(job)
-            pairs.append((encode(qa).astype(np.int32),
-                          encode(ta).astype(np.int32)))
+
+        def attempt(sub):
+            faults.check("align.run", sub)
+            pairs = []
+            for job in sub:
+                qa, ta = pipeline.align_job(job)
+                pairs.append((encode(qa).astype(np.int32),
+                              encode(ta).astype(np.int32)))
+            return align_pairs(pairs)
+
         try:
-            results = align_pairs(pairs)
-        except Exception as e:  # noqa: BLE001
+            pairs_results, quarantined = rl.serve_with_bisect(
+                group, attempt, tier="hirschberg", report=report)
+            for sub, results in pairs_results:
+                for job, ops in zip(sub, results):
+                    if ops is None:
+                        continue  # band escape: host aligns it
+                    pipeline.set_job_cigar(job, ops_to_cigar(ops))
+                    served += 1
+                    if report is not None:
+                        report.record_served("hirschberg")
+            for job, exc in quarantined:
+                if report is not None:
+                    report.record_quarantine(job, exc)
+        except Exception as e:  # noqa: BLE001 — lattice boundary
+            cause = e.cause if isinstance(e, rl.TierDead) else e
             print(f"[racon_tpu::align] WARNING: hirschberg engine failed "
-                  f"({type(e).__name__}: {e}); {len(jobs) - off} remaining "
-                  f"jobs fall back to the host aligner", file=sys.stderr)
+                  f"({type(cause).__name__}: {cause}); {len(jobs) - off} "
+                  f"remaining jobs fall back to the host aligner",
+                  file=sys.stderr)
+            if report is not None:
+                report.record_degrade("hirschberg", "host", cause)
             break
-        for job, ops in zip(group, results):
-            if ops is None:
-                continue
-            pipeline.set_job_cigar(job, ops_to_cigar(ops))
-            served += 1
     return served

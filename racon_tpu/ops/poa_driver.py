@@ -8,6 +8,13 @@ Mirrors the reference's CUDA polish orchestration
 (cudabatch.cpp:141-160), failed windows re-polished on the host
 (:354-378), and the host-side trim identical to the CPU path
 (cudabatch.cpp:230-256).
+
+Failure handling runs through the explicit degradation lattice
+(racon_tpu/resilience/lattice.py): tiers ls -> v2 -> xla -> host, with
+per-tier bounded retry, a per-device-call watchdog, and batch bisection
+so one poisoned window is quarantined to the host instead of demoting the
+whole run a tier.  Every seam carries a named fault-injection point
+(resilience/faults.py) so each edge is deterministically testable in CI.
 """
 
 from __future__ import annotations
@@ -15,16 +22,22 @@ from __future__ import annotations
 import functools
 import os
 import sys
+import time
 from collections import deque
 from typing import List
 
 import numpy as np
 
+from ..resilience import faults
+from ..resilience import lattice as rl
+from ..resilience.report import PhaseReport
 from . import poa
 from .encoding import decode, encode
 
 DEPTH_CAP = 200                    # reference: MAX_DEPTH_PER_WINDOW
 DEPTH_BUCKETS = (8, 32, DEPTH_CAP)
+
+_PALLAS_KINDS = ("ls", "v2")
 
 
 def _pipeline_depth() -> int:
@@ -45,11 +58,11 @@ def _kernel_kind() -> str:
 
     'ls' (default) — v3 lane-lockstep, 8 windows per program
     (poa_pallas_ls.py); 'v2' — one window per program (poa_pallas.py).
-    Either degrades v2 -> XLA (and ls -> v2 -> XLA) through the same
-    lattice on Mosaic failure.
+    Either degrades through the lattice (ls -> v2 -> xla -> host) on
+    Mosaic failure.
     """
     k = os.environ.get("RACON_TPU_POA_KERNEL", "ls")
-    if k not in ("ls", "v2"):
+    if k not in _PALLAS_KINDS:
         raise ValueError(
             f"RACON_TPU_POA_KERNEL must be 'ls' or 'v2', got {k!r}")
     return k
@@ -127,10 +140,15 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
     concurrently with kernel execution
     (/root/reference/src/cuda/cudapolisher.cpp:83-145).
 
-    Returns stats {device:…, host_fallback:…, backbone:…}.
+    Returns stats {device:…, host_fallback:…, backbone:…, failed:…,
+    layers_dropped:…, report: PhaseReport} — the report's per-tier served
+    counts sum to the window count, clean or fault-injected.
     """
     n = pipeline.num_windows()
-    stats = {"device": 0, "host_fallback": 0, "backbone": 0, "failed": 0}
+    report = PhaseReport("consensus", rl.CONSENSUS_TIERS + ("backbone",))
+    report.total = n
+    stats = {"device": 0, "host_fallback": 0, "backbone": 0, "failed": 0,
+             "layers_dropped": 0, "report": report}
 
     fallback: List[int] = []
 
@@ -142,16 +160,22 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
         if k < 2:
             # <3 sequences incl. backbone: backbone passthrough
             # (reference: src/window.cpp:68-71)
-            wx = pipeline.export_window(i)
+            try:
+                wx = pipeline.export_window(i)
+            except Exception as e:  # noqa: BLE001 — export seam
+                fallback.append(i)
+                report.record_quarantine(i, e)
+                continue
             pipeline.set_consensus(i, wx.backbone.tobytes(), False)
             stats["backbone"] += 1
             continue
         jobs.append((i, min(k, DEPTH_CAP), bb_len))
+    report.record_served("backbone", stats["backbone"])
 
     if jobs:
         n_dev = _n_devices()
-        kind = _kernel_kind()
-        B = _device_batch(n_dev, kind)
+        requested = _kernel_kind()
+        B = _device_batch(n_dev, requested)
         use_pallas = _use_pallas()
         # Bucket by (depth, backbone class) to bound padding waste in BOTH
         # dims: layers dropped at pack time (oversized/empty) only shrink
@@ -165,7 +189,7 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
             buckets.setdefault((bucket, window_class(bb)),
                                []).append((i, depth, bb))
 
-        # In-flight chunks: (chunk, packed, outs, cfg, pallas, kind).
+        # In-flight chunks: (chunk, packed, outs, cfg, kind).
         # JAX dispatch is async, so with depth Q the host packs/exports
         # chunks N+1..N+Q while chunk N executes — the analogue of the
         # reference's continuous batch fill running concurrently with
@@ -174,23 +198,21 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
         # fluctuates; more mostly adds host memory (Q packed batches).
         pending = deque()
         q_depth = _pipeline_depth()
-        # geometries (cfg, kind) whose pallas kernel already failed —
-        # seeded from warm-up failures so the measured run never retries
-        # a kernel the warm-up proved dead
+        # geometries (cfg, kind) whose kernel already failed — seeded from
+        # warm-up failures so the measured run never retries a kernel the
+        # warm-up proved dead
         dead_geoms = set(_WARM_DEAD)
         for (depth_bucket, wl_class), bucket_jobs in sorted(buckets.items()):
             cfg = make_config(wl_class, depth_bucket, match, mismatch, gap)
             # Large window geometries (e.g. -w 1000) overflow the fused
-            # kernel's VMEM budget; the flag must flip HERE so _submit and
-            # _unpack agree with the kernel _build_kernel actually returns.
-            bucket_pallas, bucket_kind = _pick_tier(cfg, use_pallas, kind)
+            # kernel's VMEM budget; the entry tier is picked per geometry.
+            entry_kind = _pick_tier(cfg, use_pallas, requested)
             # (Per-bucket depth is kept deliberately: the fused kernel's
             # VMEM footprint is depth-independent now, but packing and
             # host->device transfer scale with the padded depth — a single
             # DEPTH_CAP geometry would ship ~25x zeros for the shallow
             # buckets on every chunk to save compiles that the lru +
             # persistent compilation caches already amortize.)
-            kernel = _build_kernel(cfg, B, bucket_pallas, bucket_kind)
             # Sequential loops run lock-step across the batch, so keep
             # batches depth-homogeneous — and length-homogeneous within
             # equal depth: a lockstep program's DP range is the union
@@ -198,51 +220,61 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
             # group bills it the long group's ranks.
             bucket_jobs.sort(key=lambda job: (job[1], job[2]))
             for off in range(0, len(bucket_jobs), B):
-                while bucket_pallas and (cfg, bucket_kind) in dead_geoms:
-                    # an earlier chunk (or the warm-up) proved this tier
-                    # dead for this geometry: step down before dispatching
-                    bucket_pallas, kernel, bucket_kind = _step_down(
-                        cfg, B, bucket_kind, dead_geoms)
                 idxs = [i for i, _, _ in bucket_jobs[off:off + B]]
+                # best LIVE tier for this geometry (earlier chunks or the
+                # warm-up may have proven tiers dead)
+                kernel, kind = _live_tier(cfg, B, entry_kind, dead_geoms,
+                                          report)
+                if kind == "host":
+                    fallback.extend(idxs)
+                    continue
+                chunk = _export_chunk(pipeline, idxs, cfg, fallback,
+                                      stats, report)
+                if not chunk:
+                    continue
                 # Always pad to B: a dataset-size-dependent final-chunk
                 # shape would force an extra jit compile per distinct
                 # remainder (padded windows are 1-base/0-layer — free).
-                pad = B
-                chunk = _export_chunk(pipeline, idxs, cfg, fallback)
-                if not chunk:
+                packed = _pack(chunk, cfg, B)
+                try:
+                    faults.check(f"poa.run.{kind}",
+                                 [i for i, _, _ in chunk])
+                    outs = _submit(kernel, packed, kind in _PALLAS_KINDS)
+                except Exception as e:  # noqa: BLE001 — lattice boundary
+                    # synchronous dispatch failure: resolve this chunk
+                    # through the lattice right now (retry/bisect/demote)
+                    report.record_failure(kind, e)
+                    report.retries += 1
+                    _resolve(pipeline, chunk, None, cfg, B, kind,
+                             dead_geoms, trim, stats, fallback, report)
                     continue
-                packed = _pack(chunk, cfg, pad)
-                while True:
-                    try:
-                        outs = _submit(kernel, packed, bucket_pallas)
-                        break
-                    except Exception as e:  # noqa: BLE001
-                        if not bucket_pallas:
-                            raise
-                        dead_geoms.add((cfg, bucket_kind))
-                        bucket_pallas, kernel, bucket_kind = _degrade(
-                            e, cfg, B, bucket_kind, dead_geoms)
-                pending.append((chunk, packed, outs, cfg, bucket_pallas,
-                                bucket_kind))
+                pending.append((chunk, packed, outs, cfg, kind))
                 if len(pending) >= q_depth:
                     _drain(pipeline, pending.popleft(), trim, stats,
-                           fallback, B, dead_geoms)
+                           fallback, B, dead_geoms, report)
             if progress:
                 print(f"[racon_tpu::poa] bucket depth<={depth_bucket} "
                       f"len<={wl_class}: {len(bucket_jobs)} windows",
                       file=sys.stderr)
         while pending:
             _drain(pipeline, pending.popleft(), trim, stats, fallback, B,
-                   dead_geoms)
+                   dead_geoms, report)
 
+    t0 = time.perf_counter()
     for i in fallback:
         pipeline.consensus_cpu_one(i)
         stats["host_fallback"] += 1
-
+    report.add_wall("host", time.perf_counter() - t0)
+    report.record_served("host", stats["host_fallback"])
+    report.extra["device_rejected"] = stats["failed"]
+    # layers dropped by this class's max_len admission (per-class geometry
+    # change, ADVICE.md): attributes serving-mix shifts on mixed-length
+    # datasets
+    report.extra["layers_dropped_maxlen"] = stats["layers_dropped"]
     return stats
 
 
-# (cfg, kind) pairs whose pallas kernel failed during warm-up; consulted by
+# (cfg, kind) pairs whose kernel failed during warm-up; consulted by
 # run_consensus_phase so the measured run dispatches straight to the tier
 # the warm-up landed on instead of re-paying a compile-and-fail.
 _WARM_DEAD: set = set()
@@ -264,92 +296,145 @@ def warm_geometries(window_lengths, match: int, mismatch: int,
         window_lengths = [window_lengths]
     classes = sorted({window_class(max(w, 1)) for w in window_lengths})
     n_dev = _n_devices()
-    kind = _kernel_kind()
-    B = _device_batch(n_dev, kind)
+    requested = _kernel_kind()
+    B = _device_batch(n_dev, requested)
     use_pallas = _use_pallas()
     import itertools
     for depth_bucket, wl_class in itertools.product(DEPTH_BUCKETS, classes):
         cfg = make_config(wl_class, depth_bucket, match, mismatch, gap)
-        bucket_pallas, bucket_kind = _pick_tier(cfg, use_pallas, kind)
-        kernel = _build_kernel(cfg, B, bucket_pallas, bucket_kind)
-        packed = _pack([], cfg, B)
-        while True:
-            try:
-                _unpack(_submit(kernel, packed, bucket_pallas),
-                        bucket_pallas)
+        kind = _pick_tier(cfg, use_pallas, requested)
+        while kind != "host":
+            kernel, kind = _live_tier(cfg, B, kind, _WARM_DEAD)
+            if kind == "host":
                 break
-            except Exception as e:  # noqa: BLE001
-                # same degrade philosophy as run_consensus_phase: a Mosaic
-                # failure on one geometry must not abort the caller — warm
-                # the tier it will actually fall back to, and remember the
-                # failure so the measured run doesn't retry it
-                if not bucket_pallas:
-                    raise
-                _WARM_DEAD.add((cfg, bucket_kind))
-                bucket_pallas, kernel, bucket_kind = _degrade(
-                    e, cfg, B, bucket_kind, _WARM_DEAD)
+            try:
+                faults.check(f"poa.run.{kind}", ())
+                _unpack(_submit(kernel, _pack([], cfg, B),
+                                kind in _PALLAS_KINDS),
+                        kind in _PALLAS_KINDS)
+                break
+            except Exception as e:  # noqa: BLE001 — same degrade
+                # philosophy as run_consensus_phase: a Mosaic failure on
+                # one geometry must not abort the caller — warm the tier
+                # it will actually fall back to, and remember the failure
+                # so the measured run doesn't retry it
+                _WARM_DEAD.add((cfg, kind))
+                nxt = _next_tier(cfg, kind)
+                _warn_degrade(e, nxt)
+                kind = nxt
 
 
-def _pick_tier(cfg, use_pallas: bool, kind: str):
-    """(bucket_pallas, bucket_kind) after VMEM-fit checks: the requested
+def _pick_tier(cfg, use_pallas: bool, kind: str) -> str:
+    """Entry tier for a geometry after VMEM-fit checks: the requested
     pallas tier if it fits, else the next tier down."""
     if not use_pallas:
-        return False, kind
+        return "xla"
     if _fits_vmem(cfg, kind):
-        return True, kind
+        return kind
     if kind == "ls" and _fits_vmem(cfg, "v2"):
-        return True, "v2"
-    return False, kind
+        return "v2"
+    return "xla"
 
 
-def _step_down(cfg, B, kind, dead_geoms=()):
-    """Next LIVE tier below (pallas `kind`) for this geometry:
-    ls -> v2 (if it fits and isn't already proven dead) -> XLA.
-    Returns (use_pallas, kernel, kind)."""
-    if (kind == "ls" and _fits_vmem(cfg, "v2")
-            and (cfg, "v2") not in dead_geoms):
-        return True, _build_kernel(cfg, B, True, "v2"), "v2"
-    return False, _build_kernel(cfg, B, False, kind), kind
+def _next_tier(cfg, kind: str) -> str:
+    """The lattice tier below `kind` for this geometry (VMEM-aware)."""
+    if kind == "ls" and _fits_vmem(cfg, "v2"):
+        return "v2"
+    if kind in _PALLAS_KINDS:
+        return "xla"
+    return "host"
 
 
-def _degrade(e, cfg, B, kind, dead_geoms=()):
-    """Mosaic compile/runtime failure: fall back to the next live kernel
-    tier for this geometry (same philosophy as the per-window host
-    fallback). Tiers already in dead_geoms are skipped so a drain-time ls
-    failure doesn't pay a doomed submit through an already-dead v2."""
-    use_p, kernel, new_kind = _step_down(cfg, B, kind, dead_geoms)
-    tier = f"pallas '{new_kind}'" if use_p else "XLA"
-    print("[racon_tpu::poa] WARNING: pallas kernel failed "
-          f"({type(e).__name__}: {e}); falling back to the {tier} kernel",
+def _live_tier(cfg, B, kind, dead_geoms, report=None):
+    """Kernel for the best LIVE tier at or below `kind` for this geometry,
+    stepping past tiers proven dead and tiers whose kernel build fails
+    (compile failures demote exactly like runtime failures).  Returns
+    (kernel, kind); kernel is None iff kind == 'host'."""
+    while kind != "host":
+        if (cfg, kind) in dead_geoms:
+            kind = _next_tier(cfg, kind)
+            continue
+        try:
+            return _build_kernel(cfg, B, kind in _PALLAS_KINDS, kind), kind
+        except Exception as e:  # noqa: BLE001 — compile seam
+            dead_geoms.add((cfg, kind))
+            nxt = _next_tier(cfg, kind)
+            if report is not None:
+                report.record_failure(kind, e)
+                report.record_degrade(kind, nxt, e)
+            _warn_degrade(e, nxt)
+            kind = nxt
+    return None, "host"
+
+
+def _warn_degrade(e, to_kind: str) -> None:
+    tier = (f"the pallas '{to_kind}' kernel" if to_kind in _PALLAS_KINDS
+            else "the XLA kernel" if to_kind == "xla"
+            else "the host engine")
+    print(f"[racon_tpu::poa] WARNING: kernel tier failed "
+          f"({type(e).__name__}: {e}); falling back to {tier}",
           file=sys.stderr)
-    return use_p, kernel, new_kind
 
 
-def _drain(pipeline, pending, trim, stats, fallback, B, dead_geoms):
+def _resolve(pipeline, chunk, outs, cfg, B, kind, dead_geoms, trim, stats,
+             fallback, report):
+    """Fully serve one exported chunk through the lattice, starting at
+    `kind` with optionally already-dispatched device futures `outs`.
+
+    Per tier: bounded retry, then batch bisection (a poisoned window is
+    quarantined to the host while the rest of the batch stays on the
+    device); a batch-independent failure (TierDead) demotes the geometry
+    one tier, down to the host floor.
+    """
+    submitted_kind = kind
+    while True:
+        kernel, kind = _live_tier(cfg, B, kind, dead_geoms, report)
+        if kind == "host":
+            for i, _, _ in chunk:
+                fallback.append(i)
+            return
+        pallas = kind in _PALLAS_KINDS
+
+        def attempt(sub, _kernel=kernel, _kind=kind, _pallas=pallas):
+            faults.check(f"poa.run.{_kind}", [i for i, _, _ in sub])
+            return _unpack(_submit(_kernel, _pack(sub, cfg, B), _pallas),
+                           _pallas)
+
+        # the pipelined futures are only valid for the tier they were
+        # dispatched on; a demotion in between invalidates them
+        cached = None
+        if outs is not None and kind == submitted_kind:
+            cached = (lambda _o=outs, _p=pallas: _unpack(_o, _p))
+        try:
+            pairs, quarantined = rl.serve_with_bisect(
+                chunk, attempt, tier=kind, report=report, cached=cached)
+        except rl.TierDead as td:
+            dead_geoms.add((cfg, kind))
+            nxt = _next_tier(cfg, kind)
+            report.record_degrade(kind, nxt, td.cause)
+            _warn_degrade(td.cause, nxt)
+            outs = None
+            kind = nxt
+            continue
+        for sub, results in pairs:
+            _install(pipeline, sub, results, trim, stats, fallback,
+                     report, kind)
+        for item, exc in quarantined:
+            fallback.append(item[0])
+            report.record_quarantine(item[0], exc)
+        return
+
+
+def _drain(pipeline, pending, trim, stats, fallback, B, dead_geoms,
+           report):
     """Block on an in-flight chunk's device results and install them.
 
-    If the pallas kernel failed at runtime (error surfaces at the blocking
-    transfer), re-run the chunk through the next tier down — the packed
-    arrays are still on hand, so no re-export is needed — and mark the
-    geometry dead so the bucket loop stops dispatching through the broken
-    kernel.
-    """
-    chunk, packed, outs, cfg, was_pallas, kind = pending
-    kernel = None
-    while True:
-        try:
-            if outs is None:
-                outs = _submit(kernel, packed, was_pallas)
-            results = _unpack(outs, was_pallas)
-            break
-        except Exception as e:  # noqa: BLE001
-            if not was_pallas:
-                raise
-            dead_geoms.add((cfg, kind))
-            was_pallas, kernel, kind = _degrade(e, cfg, B, kind, dead_geoms)
-            outs = None  # re-submit inside the try: a synchronous failure
-            # of the intermediate v2 tier must also degrade, not escape
-    _install(pipeline, chunk, results, trim, stats, fallback)
+    If the kernel failed at runtime (error surfaces at the blocking
+    transfer), the chunk is resolved through the lattice — retry, bisect,
+    demote — with the packed arrays still on hand."""
+    chunk, packed, outs, cfg, kind = pending
+    _resolve(pipeline, chunk, outs, cfg, B, kind, dead_geoms, trim, stats,
+             fallback, report)
 
 
 def _use_pallas() -> bool:
@@ -363,6 +448,11 @@ def _use_pallas() -> bool:
 def _n_devices() -> int:
     import jax
     return len(jax.devices())
+
+
+def _platform() -> str:
+    import jax
+    return jax.devices()[0].platform
 
 
 def _fits_vmem(cfg, kind: str = "v2", budget_bytes: int = 14 << 20) -> bool:
@@ -400,29 +490,31 @@ def _build_kernel(cfg, B, use_pallas, kind: str = "v2"):
     `kind`, so normalize it out of the key — a warm-up that degraded to
     the twin under 'v2' must hit the same cache entry as a measured-run
     request arriving via the 'ls' step-down (and as __graft_entry__'s
-    default-argument call)."""
+    default-argument call).  The device topology (count + platform) is
+    part of the key: reconfiguring JAX devices after a first build must
+    never serve a stale sharded/interpreted kernel (ADVICE.md)."""
     if not use_pallas:
         kind = "xla"
-    return _build_kernel_cached(cfg, B, use_pallas, kind)
+    faults.check(f"poa.compile.{kind}")
+    return _build_kernel_cached(cfg, B, use_pallas, kind, _n_devices(),
+                                _platform())
 
 
 @functools.lru_cache(maxsize=64)
-def _build_kernel_cached(cfg, B, use_pallas, kind):
+def _build_kernel_cached(cfg, B, use_pallas, kind, n_dev, platform):
     """Single- or multi-device kernel for a B-window batch.
 
     Multi-device: batch dim sharded over the 1-D `windows` mesh — the
     production analogue of the reference's multi-GPU batch striping
     (src/cuda/cudapolisher.cpp:228-240), with no collectives.
 
-    Memoized on the full geometry key: the warm-up's compiled kernel IS
-    the measured run's function object, so the in-process jit cache hits
-    even when the persistent disk cache can't serve (observed: AOT
-    entries compiled under different machine features fail to load and
-    silently recompile — minutes per geometry on the CPU twin).
+    Memoized on the full geometry key — including the device topology
+    (n_dev, platform): the warm-up's compiled kernel IS the measured
+    run's function object, so the in-process jit cache hits even when the
+    persistent disk cache can't serve (observed: AOT entries compiled
+    under different machine features fail to load and silently recompile
+    — minutes per geometry on the CPU twin).
     """
-    import jax
-
-    n_dev = _n_devices()
     assert not (use_pallas and not _fits_vmem(cfg, kind)), (
         "caller must check _fits_vmem before requesting the pallas kernel")
     if use_pallas:
@@ -430,7 +522,7 @@ def _build_kernel_cached(cfg, B, use_pallas, kind):
             from .poa_pallas_ls import build_lockstep_poa_kernel as build
         else:
             from .poa_pallas import build_pallas_poa_kernel as build
-        interp = jax.devices()[0].platform != "tpu"
+        interp = platform != "tpu"
         if n_dev == 1:
             return build(cfg, interpret=interp)(B)
         from ..parallel.mesh import shard_batch_build
@@ -445,17 +537,31 @@ def _build_kernel_cached(cfg, B, use_pallas, kind):
     return shard_batch_kernel(kernel, device_mesh(), 9)
 
 
-def _export_chunk(pipeline, idxs, cfg, fallback):
+def _export_chunk(pipeline, idxs, cfg, fallback, stats=None, report=None):
     """Export window bases for one chunk; apply per-layer admission.
 
     Returns [(window_idx, export, kept layer indices)] — windows the device
-    can't represent go straight to the host fallback list.
+    can't represent go straight to the host fallback list, and an export
+    failure (the `window.export` seam) quarantines just that window.
     """
     chunk = []
     for i in idxs:
-        wx = pipeline.export_window(i)
+        try:
+            wx = pipeline.export_window(i)
+        except Exception as e:  # noqa: BLE001 — export seam
+            fallback.append(i)
+            if report is not None:
+                report.record_quarantine(i, e)
+            continue
         k = len(wx.lens)
         keep = [j for j in range(k) if 0 < wx.lens[j] <= cfg.max_len]
+        # Per-class geometry admission (ADVICE.md): a layer longer than
+        # THIS class's max_len is dropped here where the old dataset-max
+        # geometry admitted it; counted so serving-mix shifts on
+        # mixed-length datasets stay attributable.
+        if stats is not None:
+            stats["layers_dropped"] += int(
+                sum(1 for ln in wx.lens[:DEPTH_CAP] if ln > cfg.max_len))
         if len(keep) < len(wx.lens[:DEPTH_CAP]) and len(keep) < 2:
             fallback.append(i)
             continue
@@ -533,7 +639,8 @@ def _unpack(outs, use_pallas):
     return cons_base, cons_cov, cons_len, failed
 
 
-def _install(pipeline, chunk, results, trim, stats, fallback):
+def _install(pipeline, chunk, results, trim, stats, fallback, report=None,
+             tier=None):
     cons_base, cons_cov, cons_len, failed = results
     for bi, (i, wx, keep) in enumerate(chunk):
         if failed[bi]:
@@ -562,3 +669,5 @@ def _install(pipeline, chunk, results, trim, stats, fallback):
             kept_codes = out
         pipeline.set_consensus(i, decode(kept_codes), True)
         stats["device"] += 1
+        if report is not None and tier is not None:
+            report.record_served(tier)

@@ -15,6 +15,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from . import native
+from .resilience import faults
 
 
 @dataclass
@@ -99,6 +100,7 @@ class Pipeline:
         self._lib.rt_pipeline_set_job_cigar(self._h, job, cigar.encode())
 
     def align_jobs_cpu(self) -> None:
+        faults.check("native.call")
         self._lib.rt_pipeline_align_jobs_cpu(self._h)
         native.check_error(self._lib)
 
@@ -121,6 +123,7 @@ class Pipeline:
                 int(out[4]), int(out[5]))
 
     def export_window(self, i: int) -> WindowExport:
+        faults.check("window.export", (i,))
         n_seqs, bb_len, rank, is_tgs, layer_bytes, target_id = self.window_info(i)
         k = n_seqs - 1
         bb = np.zeros(bb_len, dtype=np.uint8)
@@ -144,6 +147,7 @@ class Pipeline:
                             weights=weights)
 
     def consensus_cpu_one(self, i: int) -> bool:
+        faults.check("native.call", (i,))
         r = self._lib.rt_pipeline_consensus_cpu_one(self._h, i)
         if r < 0:
             native.check_error(self._lib)
@@ -151,6 +155,7 @@ class Pipeline:
         return bool(r)
 
     def consensus_cpu_all(self) -> None:
+        faults.check("native.call")
         self._lib.rt_pipeline_consensus_cpu_all(self._h)
         native.check_error(self._lib)
 

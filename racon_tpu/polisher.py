@@ -13,6 +13,8 @@ from __future__ import annotations
 from typing import List, Tuple
 
 from .pipeline import Pipeline
+from .resilience import faults
+from .resilience.report import RunReport
 
 
 class CpuPolisher:
@@ -20,26 +22,37 @@ class CpuPolisher:
 
     def __init__(self, sequences_path: str, overlaps_path: str,
                  target_path: str, **kwargs):
+        faults.reset()  # per-run firing schedule (deterministic)
         self._pipeline = Pipeline(sequences_path, overlaps_path, target_path,
                                   **kwargs)
+        self.report = RunReport()
 
     def initialize(self) -> None:
         self._pipeline.initialize()
 
     def polish(self, drop_unpolished: bool = True) -> List[Tuple[str, str]]:
         self._pipeline.consensus_cpu_all()
-        return self._pipeline.stitch(drop_unpolished)
+        out = self._pipeline.stitch(drop_unpolished)
+        self.report.finalize().write_env()
+        return out
 
 
 class TpuPolisher:
     """TPU-backed polishing: batched banded alignment + batched POA on
-    device, host fallback for work outside device limits."""
+    device, host fallback for work outside device limits.
+
+    After polish(), `self.report` (a resilience.report.RunReport) holds
+    the per-phase serving/fallback accounting — who served what, why
+    anything fell back, retries/bisections, quarantined windows, wall
+    time per tier."""
 
     def __init__(self, sequences_path: str, overlaps_path: str,
                  target_path: str, **kwargs):
+        faults.reset()  # per-run firing schedule (deterministic)
         self._kwargs = dict(kwargs)
         self._pipeline = Pipeline(sequences_path, overlaps_path, target_path,
                                   **kwargs)
+        self.report = RunReport()
 
     def initialize(self) -> None:
         try:
@@ -50,18 +63,22 @@ class TpuPolisher:
                 "run without --tpu for the host path") from e
 
         self._pipeline.prepare()
-        run_alignment_phase(self._pipeline)   # device + host fallback
+        stats = run_alignment_phase(self._pipeline)  # device + host fallback
+        self.report.attach(stats.get("report"))
         self._pipeline.build_windows()
 
     def polish(self, drop_unpolished: bool = True) -> List[Tuple[str, str]]:
         from .ops.poa_driver import run_consensus_phase
 
-        run_consensus_phase(self._pipeline,
-                            match=self._kwargs.get("match", 3),
-                            mismatch=self._kwargs.get("mismatch", -5),
-                            gap=self._kwargs.get("gap", -4),
-                            trim=self._kwargs.get("trim", True))
-        return self._pipeline.stitch(drop_unpolished)
+        stats = run_consensus_phase(self._pipeline,
+                                    match=self._kwargs.get("match", 3),
+                                    mismatch=self._kwargs.get("mismatch", -5),
+                                    gap=self._kwargs.get("gap", -4),
+                                    trim=self._kwargs.get("trim", True))
+        self.report.attach(stats.get("report"))
+        out = self._pipeline.stitch(drop_unpolished)
+        self.report.finalize().write_env()
+        return out
 
 
 def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,

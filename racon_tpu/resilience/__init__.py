@@ -1,0 +1,34 @@
+"""Resilience layer: deterministic fault injection, the unified
+degradation lattice, and the machine-readable run report.
+
+The reference racon degrades gracefully when the accelerator rejects work
+— failed CUDA batches are re-polished on the host
+(/root/reference/src/cuda/cudapolisher.cpp:354-378). This package makes
+that posture a tested subsystem instead of scattered try/except blocks:
+
+* `faults`  — named injection points at every device/host seam, driven by
+  the `RACON_TPU_FAULT` env spec, so any lattice edge can be triggered
+  deterministically on the CPU backend in CI.
+* `lattice` — the ordered degradation tiers (ls -> v2 -> xla -> host for
+  consensus; hirschberg/xla -> host for alignment) plus the shared
+  retry / watchdog / batch-bisection machinery the drivers run through.
+* `report`  — per-phase serving/fallback accounting surfaced through
+  `Polisher.polish()`, the `--report` CLI flag, `RACON_TPU_REPORT`, and
+  `bench.py` / `tools/hw_session.py`.
+"""
+
+from . import faults, lattice, report  # noqa: F401
+from .faults import InjectedFault, MosaicError, check, parse_spec, reset
+from .lattice import (ALIGN_TIERS, CONSENSUS_TIERS, TierDead,
+                      WatchdogTimeout, call_with_watchdog, device_timeout,
+                      serve_with_bisect, tier_retries)
+from .report import PhaseReport, RunReport
+
+__all__ = [
+    "faults", "lattice", "report",
+    "InjectedFault", "MosaicError", "check", "parse_spec", "reset",
+    "ALIGN_TIERS", "CONSENSUS_TIERS", "TierDead", "WatchdogTimeout",
+    "call_with_watchdog", "device_timeout", "serve_with_bisect",
+    "tier_retries",
+    "PhaseReport", "RunReport",
+]

@@ -1,0 +1,225 @@
+"""Deterministic fault injection at the device/host seams.
+
+Every place the drivers hand work to (or take results back from) an
+accelerator kernel or the native host engine is a named *injection
+point*.  The `RACON_TPU_FAULT` environment variable arms one or more of
+them:
+
+    RACON_TPU_FAULT="poa.run.ls:raise=MosaicError"
+    RACON_TPU_FAULT="poa.run.xla:window=5"
+    RACON_TPU_FAULT="align.run:batch=1:count=1,poa.run.v2:hang=2"
+
+Spec grammar (comma-separated specs; colon-separated fields):
+
+    <point>[:batch=N][:window=I][:count=N][:hang=SECONDS][:raise=NAME]
+
+* `point`   — one of KNOWN_POINTS below.  The first field.
+* `batch=N` — fire only on the Nth invocation of the point (0-based,
+  counted per point per run).  Retries re-invoke the point, so a
+  `batch=0:count=1` fault fails the first attempt and lets the retry
+  succeed — the deterministic transient fault.
+* `window=I`— fire only when window/job index I is in the submitted
+  batch (run points pass the batch's indices).  Batch bisection narrows
+  such a fault down to the poisoned window, which is quarantined to the
+  host while the rest of the batch stays on the device.
+* `count=N` — fire at most N times (default: unlimited — the point is
+  permanently broken, which is how a whole tier is killed).
+* `hang=S`  — sleep S seconds instead of raising (exercises the
+  per-device-call watchdog; combine with `RACON_TPU_DEVICE_TIMEOUT`).
+* `raise=NAME` — exception class to raise (default `MosaicError`, the
+  synthetic stand-in for a Mosaic compile/runtime failure).
+
+Specs are validated eagerly: a malformed spec raises `ValueError` with a
+single-line message (the CLI surfaces it as exit 1, reference-style).
+Counters are per-run — `reset()` is called by the polisher constructors
+so consecutive runs in one process see identical firing schedules.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+ENV = "RACON_TPU_FAULT"
+
+#: Every injection point the drivers expose.  Compile points fire when a
+#: kernel for that tier is (re)built; run points fire on every batch
+#: submitted to that tier; the host seams fire per native call / window
+#: export.
+KNOWN_POINTS = frozenset({
+    "align.compile",     # phase-1 device engine kernel build
+    "align.run",         # phase-1 device engine, per cohort
+    "poa.compile.ls",    # lockstep consensus kernel build
+    "poa.compile.v2",    # one-window consensus kernel build
+    "poa.compile.xla",   # XLA-twin consensus kernel build
+    "poa.run.ls",        # lockstep consensus, per submitted batch
+    "poa.run.v2",        # one-window consensus, per submitted batch
+    "poa.run.xla",       # XLA-twin consensus, per submitted batch
+    "native.call",       # host (native) engine calls — the lattice floor
+    "window.export",     # per-window export from the native pipeline
+})
+
+
+class InjectedFault(Exception):
+    """Base class for synthetic injected failures."""
+
+
+class MosaicError(InjectedFault):
+    """Synthetic stand-in for a Mosaic compile/runtime failure."""
+
+
+#: Exception classes a spec may name.  Builtins are included so the
+#: lattice's broad-Exception handling is exercised with realistic types.
+EXCEPTIONS = {
+    "MosaicError": MosaicError,
+    "InjectedFault": InjectedFault,
+    "RuntimeError": RuntimeError,
+    "ValueError": ValueError,
+    "TimeoutError": TimeoutError,
+    "OSError": OSError,
+}
+
+_UNLIMITED = -1
+
+
+@dataclass
+class FaultSpec:
+    point: str
+    batch: Optional[int] = None
+    window: Optional[int] = None
+    count: int = _UNLIMITED
+    hang: float = 0.0
+    raise_name: str = "MosaicError"
+    fired: int = field(default=0, compare=False)
+
+    def spent(self) -> bool:
+        return self.count != _UNLIMITED and self.fired >= self.count
+
+    def describe(self) -> str:
+        sel = []
+        if self.batch is not None:
+            sel.append(f"batch={self.batch}")
+        if self.window is not None:
+            sel.append(f"window={self.window}")
+        return ":".join([self.point, *sel]) or self.point
+
+
+def parse_spec(text: str) -> list:
+    """Parse a RACON_TPU_FAULT value; raises ValueError on any malformed
+    field (unknown point, unknown key, non-integer selector, unknown
+    exception name) with a single-line message."""
+    specs = []
+    for part in filter(None, (p.strip() for p in text.split(","))):
+        fields = part.split(":")
+        point = fields[0]
+        if point not in KNOWN_POINTS:
+            raise ValueError(
+                f"{ENV}: unknown injection point {point!r} "
+                f"(valid: {', '.join(sorted(KNOWN_POINTS))})")
+        spec = FaultSpec(point)
+        for f in fields[1:]:
+            key, sep, val = f.partition("=")
+            if not sep:
+                raise ValueError(f"{ENV}: expected key=value, got {f!r}")
+            try:
+                if key == "batch":
+                    spec.batch = int(val)
+                elif key == "window":
+                    spec.window = int(val)
+                elif key == "count":
+                    spec.count = int(val)
+                elif key == "hang":
+                    spec.hang = float(val)
+                elif key == "raise":
+                    if val not in EXCEPTIONS:
+                        raise ValueError(
+                            f"{ENV}: unknown exception {val!r} "
+                            f"(valid: {', '.join(sorted(EXCEPTIONS))})")
+                    spec.raise_name = val
+                else:
+                    raise ValueError(f"{ENV}: unknown key {key!r} "
+                                     f"(valid: batch, window, count, hang, "
+                                     f"raise)")
+            except ValueError as e:
+                if str(e).startswith(ENV):
+                    raise
+                raise ValueError(
+                    f"{ENV}: bad value {val!r} for {key!r}") from None
+        specs.append(spec)
+    return specs
+
+
+class FaultPlan:
+    """Parsed specs plus per-point invocation counters for one run."""
+
+    def __init__(self, specs):
+        self.specs = specs
+        self.calls = {}
+
+    def check(self, point: str,
+              windows: Optional[Sequence[int]] = None) -> None:
+        n = self.calls.get(point, 0)
+        self.calls[point] = n + 1
+        for spec in self.specs:
+            if spec.point != point or spec.spent():
+                continue
+            if spec.batch is not None and spec.batch != n:
+                continue
+            if spec.window is not None:
+                if windows is None or spec.window not in windows:
+                    continue
+            spec.fired += 1
+            if spec.hang:
+                time.sleep(spec.hang)
+                return
+            raise EXCEPTIONS[spec.raise_name](
+                f"injected fault at {spec.describe()} (invocation {n})")
+
+
+# cache keyed on the raw env string so monkeypatched environments take
+# effect immediately; counters persist while the string is unchanged
+# (reset() re-arms them at the start of each polisher run)
+_cached_env: Optional[str] = None
+_cached_plan: Optional[FaultPlan] = None
+
+
+def _plan() -> Optional[FaultPlan]:
+    global _cached_env, _cached_plan
+    env = os.environ.get(ENV, "")
+    if env != _cached_env:
+        _cached_env = env
+        _cached_plan = FaultPlan(parse_spec(env)) if env else None
+    return _cached_plan
+
+
+def active_spec() -> str:
+    """The armed spec string ('' when fault injection is off)."""
+    return os.environ.get(ENV, "")
+
+
+def check(point: str, windows: Optional[Sequence[int]] = None) -> None:
+    """Fire any armed fault for `point`.  `windows`: the window/job
+    indices in the batch being submitted (run points only).  No-op when
+    RACON_TPU_FAULT is unset; raises ValueError on a malformed spec."""
+    assert point in KNOWN_POINTS, point
+    plan = _plan()
+    if plan is not None:
+        plan.check(point, windows)
+
+
+def reset() -> None:
+    """Re-arm the plan (fresh counters).  Called by the polisher
+    constructors so consecutive runs fire deterministically."""
+    global _cached_env, _cached_plan
+    _cached_env = None
+    _cached_plan = None
+
+
+def validate_env() -> None:
+    """Eagerly parse RACON_TPU_FAULT; raises ValueError when malformed.
+    The CLI calls this up front so a bad spec is a single-line error."""
+    env = os.environ.get(ENV, "")
+    if env:
+        parse_spec(env)

@@ -1,0 +1,188 @@
+"""The unified degradation lattice.
+
+Ordered tiers, per-tier bounded retry, a per-device-call watchdog, and
+batch bisection — the shared machinery both drivers run their device
+calls through.  The reference implements the same posture ad hoc: failed
+CUDA batches are re-polished on the host
+(/root/reference/src/cuda/cudapolisher.cpp:354-378); here every edge is
+explicit and deterministically testable via `resilience.faults`.
+
+Tier orders (best first; a tier's failure demotes to the next):
+
+    consensus:  ls -> v2 -> xla -> host
+    alignment:  hirschberg -> host,  xla -> host
+                (the entry tier is chosen by RACON_TPU_DEVICE_ALIGNER;
+                either device engine degrades straight to the host Myers
+                aligner — there is no cross-engine demotion because the
+                xla moves-matrix tier only admits small pairs)
+
+Failure taxonomy the drivers map onto this module:
+
+* transient batch failure  -> bounded retry at the same tier
+  (`RACON_TPU_TIER_RETRIES`, default 1 extra attempt)
+* hung device call         -> watchdog timeout surfaces it as an error
+  (`RACON_TPU_DEVICE_TIMEOUT` seconds; 0/unset = disabled)
+* window-correlated failure-> batch bisection: the failing batch is
+  split, halves are probed, and the poisoned window is quarantined to
+  the host while the rest of the batch stays on the device
+* tier-wide failure        -> `TierDead` (both halves of a bisection
+  fail); the caller demotes the whole geometry one tier
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+#: Consensus kernel tiers, best first.  "host" is the floor: windows are
+#: re-polished one-by-one by the native SPOA-equivalent engine.
+CONSENSUS_TIERS = ("ls", "v2", "xla", "host")
+
+#: Alignment tiers.  hirschberg and xla are alternative entry engines
+#: (RACON_TPU_DEVICE_ALIGNER); both degrade straight to the host Myers
+#: aligner.
+ALIGN_TIERS = ("hirschberg", "xla", "host")
+
+
+class WatchdogTimeout(Exception):
+    """A device call exceeded the RACON_TPU_DEVICE_TIMEOUT watchdog."""
+
+
+class TierDead(Exception):
+    """The current tier fails batch-independently; demote the geometry."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
+def tier_retries() -> int:
+    """Extra attempts per tier before bisecting/demoting (default 1)."""
+    return max(0, int(os.environ.get("RACON_TPU_TIER_RETRIES", "1")))
+
+
+def device_timeout() -> float:
+    """Per-device-call watchdog in seconds; 0 (default) disables it."""
+    try:
+        return float(os.environ.get("RACON_TPU_DEVICE_TIMEOUT", "0"))
+    except ValueError:
+        return 0.0
+
+
+def call_with_watchdog(fn: Callable, timeout: Optional[float] = None):
+    """Run fn() under the watchdog.  With no timeout configured this is a
+    direct call (no thread).  On expiry raises WatchdogTimeout — the
+    abandoned call keeps its daemon thread (a truly hung device op cannot
+    be cancelled from Python; the caller's job is to stop feeding the
+    dead tier, which the lattice does by demoting it)."""
+    t = device_timeout() if timeout is None else timeout
+    if not t or t <= 0:
+        return fn()
+    box = {}
+
+    def runner():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed to caller
+            box["error"] = e
+
+    th = threading.Thread(target=runner, daemon=True,
+                          name="racon-tpu-watchdog-call")
+    th.start()
+    th.join(t)
+    if th.is_alive():
+        raise WatchdogTimeout(f"device call exceeded the {t:.3g}s watchdog")
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
+
+
+def serve_with_bisect(items: Sequence, attempt: Callable,
+                      *, tier: str, report=None,
+                      retries: Optional[int] = None,
+                      cached: Optional[Callable] = None
+                      ) -> Tuple[List[Tuple[list, object]],
+                                 List[Tuple[object, BaseException]]]:
+    """Serve one batch at a fixed tier with bounded retry and bisection.
+
+    items    — one opaque work unit per real window/job in the batch.
+    attempt  — attempt(sub_items) -> tier result for that sub-batch
+               (pack + submit + block); called under the watchdog.
+    cached   — optional zero-arg callable returning the full batch's
+               already-dispatched result (the async-pipelined outs);
+               tried as attempt #0 so the happy path stays pipelined.
+
+    Returns (pairs, quarantined):
+      pairs       — [(sub_items, result)] covering every served unit
+      quarantined — [(item, exception)] poisoned units for the host
+
+    Raises TierDead when failures are batch-independent (both halves of
+    a bisection fail), i.e. the tier itself is broken for this geometry
+    and the caller should demote.  Two poisoned windows landing in
+    opposite halves are indistinguishable from a dead tier and demote
+    conservatively — correctness is preserved either way (the next tier,
+    ultimately the host, serves them).
+    """
+    n_retries = tier_retries() if retries is None else retries
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        try:
+            return call_with_watchdog(fn)
+        finally:
+            if report is not None:
+                report.add_wall(tier, time.perf_counter() - t0)
+
+    def attempts(sub, use_cached):
+        last = None
+        for a in range(n_retries + 1):
+            try:
+                if a == 0 and use_cached:
+                    return timed(cached)
+                return timed(lambda: attempt(sub))
+            except Exception as e:  # noqa: BLE001 — lattice boundary
+                last = e
+                if report is not None:
+                    report.record_failure(tier, e)
+                    if a < n_retries:
+                        report.retries += 1
+        raise last
+
+    def serve(sub, use_cached):
+        try:
+            return [(list(sub), attempts(sub, use_cached))], []
+        except Exception as e:  # noqa: BLE001 — lattice boundary
+            if len(sub) <= 1:
+                return [], [(sub[0], e)]
+            if report is not None:
+                report.bisections += 1
+            mid = len(sub) // 2
+            probes = []
+            for half in (sub[:mid], sub[mid:]):
+                try:
+                    probes.append((half, timed(lambda h=half: attempt(h))))
+                except Exception as he:  # noqa: BLE001
+                    if report is not None:
+                        report.record_failure(tier, he)
+                    probes.append((half, he))
+            if all(isinstance(r, BaseException) for _, r in probes):
+                raise TierDead(e) from e
+            pairs, quarantined = [], []
+            for half, r in probes:
+                if isinstance(r, BaseException):
+                    p, q = serve(half, False)  # TierDead propagates
+                    pairs.extend(p)
+                    quarantined.extend(q)
+                else:
+                    pairs.append((list(half), r))
+            return pairs, quarantined
+
+    return serve(list(items), cached is not None)
+
+
+def next_consensus_tier(kind: str) -> str:
+    """The tier below `kind` in the consensus lattice ('host' floor)."""
+    i = CONSENSUS_TIERS.index(kind)
+    return CONSENSUS_TIERS[min(i + 1, len(CONSENSUS_TIERS) - 1)]

@@ -1,0 +1,141 @@
+"""Machine-readable run report: who served what, and why it fell back.
+
+Each polishing phase produces a `PhaseReport` (per-tier served counts,
+fallback causes, retries, bisections, quarantined window indices, wall
+time per tier); the polisher aggregates them into a `RunReport` surfaced
+through `TpuPolisher.report`, the CLI `--report PATH` flag, the
+`RACON_TPU_REPORT` env var (written at the end of `polish()` — the hook
+`bench.py` and `tools/hw_session.py` use), and the one-line bench JSON.
+
+Invariant (regression-tested): a phase's per-tier served counts sum to
+its total job/window count, clean or fault-injected.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import List, Optional, Tuple
+
+ENV_REPORT = "RACON_TPU_REPORT"
+
+#: Cap per-tier recorded cause strings / quarantined indices so a
+#: pathological run cannot balloon the report.
+_MAX_CAUSES = 20
+_MAX_QUARANTINED = 1000
+
+
+class PhaseReport:
+    """Serving/fallback accounting for one phase (alignment/consensus)."""
+
+    def __init__(self, phase: str, tiers: Tuple[str, ...]):
+        self.phase = phase
+        self.tiers = tuple(tiers)
+        self.total = 0
+        self.served = {t: 0 for t in self.tiers}
+        self.retries = 0
+        self.bisections = 0
+        self.quarantined: List[int] = []
+        self.degradations: List[dict] = []
+        self.causes = {}      # tier -> [error strings]
+        self.wall_s = {}      # tier -> accumulated seconds
+        self.extra = {}       # phase-specific counters (layers_dropped, …)
+
+    # -- recording --------------------------------------------------------
+    def record_served(self, tier: str, n: int = 1) -> None:
+        self.served[tier] = self.served.get(tier, 0) + n
+
+    def record_failure(self, tier: str, exc: BaseException) -> None:
+        lst = self.causes.setdefault(tier, [])
+        if len(lst) < _MAX_CAUSES:
+            lst.append(f"{type(exc).__name__}: {exc}")
+
+    def record_degrade(self, frm: str, to: str,
+                       exc: Optional[BaseException] = None) -> None:
+        self.degradations.append({
+            "from": frm, "to": to,
+            "error": f"{type(exc).__name__}: {exc}" if exc else None})
+
+    def record_quarantine(self, index: int,
+                          exc: Optional[BaseException] = None) -> None:
+        if len(self.quarantined) < _MAX_QUARANTINED:
+            self.quarantined.append(int(index))
+        if exc is not None:
+            self.record_failure("quarantine", exc)
+
+    def add_wall(self, tier: str, seconds: float) -> None:
+        self.wall_s[tier] = self.wall_s.get(tier, 0.0) + seconds
+
+    # -- views ------------------------------------------------------------
+    def served_total(self) -> int:
+        return sum(self.served.values())
+
+    def as_dict(self) -> dict:
+        return {
+            "phase": self.phase,
+            "total": self.total,
+            "served": dict(self.served),
+            "retries": self.retries,
+            "bisections": self.bisections,
+            "quarantined": list(self.quarantined),
+            "degradations": list(self.degradations),
+            "causes": {k: list(v) for k, v in self.causes.items()},
+            "wall_s": {k: round(v, 4) for k, v in self.wall_s.items()},
+            **({"extra": dict(self.extra)} if self.extra else {}),
+        }
+
+
+class RunReport:
+    """Aggregated per-run report (all phases + the armed fault spec)."""
+
+    def __init__(self):
+        self.phases = {}
+        self._t0 = time.time()
+        self.wall_s = None
+
+    def attach(self, phase_report: Optional[PhaseReport]) -> None:
+        if phase_report is not None:
+            self.phases[phase_report.phase] = phase_report
+
+    def finalize(self) -> "RunReport":
+        self.wall_s = time.time() - self._t0
+        return self
+
+    def as_dict(self) -> dict:
+        from .faults import active_spec
+
+        return {
+            "phases": {k: v.as_dict() for k, v in self.phases.items()},
+            "fault_spec": active_spec(),
+            "wall_s": round(self.wall_s if self.wall_s is not None
+                            else time.time() - self._t0, 3),
+        }
+
+    def summary(self) -> dict:
+        """Compact serving-mix view for logs and the bench JSON line."""
+        return {
+            phase: {"total": r.total, "served": dict(r.served),
+                    "retries": r.retries, "bisections": r.bisections,
+                    "quarantined": len(r.quarantined),
+                    "degradations": len(r.degradations)}
+            for phase, r in self.phases.items()
+        }
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.as_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    def write_env(self) -> None:
+        """Write to $RACON_TPU_REPORT when set (bench/hw_session hook);
+        a write failure warns, it never fails the polish."""
+        path = os.environ.get(ENV_REPORT)
+        if not path:
+            return
+        try:
+            self.write(path)
+        except OSError as e:
+            print(f"[racon_tpu::report] WARNING: cannot write {path}: {e}",
+                  file=sys.stderr)

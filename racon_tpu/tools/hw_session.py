@@ -159,6 +159,12 @@ def log_step(entry):
 def run_step(name, cmd, bound_s, extra_env):
     print(f"[hw_session] === {name} (bound {bound_s}s) ===", flush=True)
     env = dict(os.environ, **extra_env)
+    # every polish inside the step writes its resilience run report here
+    # (last polish wins); read back into the durable log entry so a
+    # silently degraded tier is visible in the evidence trail
+    report_path = os.path.join("/tmp", f"racon_tpu_report_{name}_"
+                               f"{os.getpid()}.json")
+    env.setdefault("RACON_TPU_REPORT", report_path)
     t0 = time.time()
     # start_new_session: a timeout must kill the step's WHOLE process
     # group — bench.py runs its own probe subprocesses, and an orphaned
@@ -186,8 +192,16 @@ def run_step(name, cmd, bound_s, extra_env):
     print(tail, flush=True)
     print(f"[hw_session] {name}: {'OK' if ok else 'FAILED'} in {dt:.0f}s",
           flush=True)
-    log_step({"step": name, "ok": ok, "wall_s": round(dt, 1),
-              "env": extra_env, "tail": tail[-600:]})
+    entry = {"step": name, "ok": ok, "wall_s": round(dt, 1),
+             "env": extra_env, "tail": tail[-600:]}
+    try:
+        with open(env["RACON_TPU_REPORT"]) as f:
+            entry["report"] = json.load(f)
+        if env["RACON_TPU_REPORT"] == report_path:
+            os.remove(report_path)
+    except (OSError, ValueError):
+        pass  # step ran no polish (probe/pins) or died before writing
+    log_step(entry)
     return ok
 
 

@@ -90,3 +90,35 @@ def test_bad_kernel_kind_env_clean_error(tmp_path):
     assert r.returncode == 1
     assert "RACON_TPU_POA_KERNEL" in r.stderr
     assert "Traceback" not in r.stderr
+
+
+def test_malformed_fault_spec_clean_error(tmp_path):
+    """A malformed RACON_TPU_FAULT spec must surface as a single-line
+    error + exit 1 from the CLI (reference-style), not a mid-run
+    traceback. Self-contained: builds its own inputs."""
+    target = "ACGT" * 30
+    with open(tmp_path / "t.fasta", "w") as f:
+        f.write(f">t\n{target}\n")
+    with open(tmp_path / "r.fasta", "w") as f:
+        for i in range(3):
+            f.write(f">r{i}\n{target}\n")
+    with open(tmp_path / "o.sam", "w") as f:
+        f.write("@HD\tVN:1.6\n")
+        for i in range(3):
+            f.write(f"r{i}\t0\tt\t1\t60\t{len(target)}M\t*\t0\t0\t{target}"
+                    f"\t*\n")
+    code = (
+        "import sys; sys.path.insert(0, %r); "
+        "from __graft_entry__ import _force_cpu; _force_cpu(1); "
+        "from racon_tpu.cli import main; "
+        "sys.exit(main(['--tpu', %r, %r, %r]))"
+    ) % (ROOT, str(tmp_path / "r.fasta"), str(tmp_path / "o.sam"),
+         str(tmp_path / "t.fasta"))
+    for bad in ("poa.run.bogus", "poa.run.ls:frobnicate=1",
+                "poa.run.ls:batch=x"):
+        r = subprocess.run([sys.executable, "-c", code],
+                           env=dict(os.environ, RACON_TPU_FAULT=bad),
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 1, (bad, r.stderr[-500:])
+        assert "RACON_TPU_FAULT" in r.stderr
+        assert "Traceback" not in r.stderr

@@ -63,8 +63,9 @@ ref = b"".join(l.strip().encode()
                if not l.startswith(">"))
 pol = res[0][1].encode()
 rc = pol.translate(bytes.maketrans(b"ACGT", b"TGCA"))[::-1]
+counters = {k: v for k, v in stats.items() if isinstance(v, int)}
 print("RESULT " + json.dumps({"ed": native.edit_distance(rc, ref),
-                              "stats": stats}))
+                              "stats": counters}))
 """
 
 
