@@ -23,13 +23,17 @@ import subprocess
 import sys
 import time
 
-MBP = float(os.environ.get("RACON_TPU_BENCH_MBP", "0.5"))
-INPUT = os.environ.get("RACON_TPU_BENCH_INPUT", "paf")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from racon_tpu import config  # noqa: E402 — central knob registry
+
+MBP = config.get_float("RACON_TPU_BENCH_MBP")
+INPUT = config.get_str("RACON_TPU_BENCH_INPUT")
 # 'ont' (default): ~8 kb reads at ~11% error — BASELINE config 2's shape.
 # 'sr': 150 bp reads at ~1% error — the short-read (chr20-class,
 # BASELINE config 4) regime: NGS-type windows (no trim), ~130 shallow
 # layers per window instead of ~30 long ones.
-PROFILE = os.environ.get("RACON_TPU_BENCH_PROFILE", "ont")
+PROFILE = config.get_str("RACON_TPU_BENCH_PROFILE")
 PROFILES = {
     "ont": dict(mean_read=8000, sub=0.05, ins=0.03, dele=0.03),
     "sr": dict(mean_read=150, sub=0.008, ins=0.001, dele=0.001),
@@ -118,7 +122,7 @@ def _forced_device() -> bool:
     device — a CPU-backend dry run of the exact healthy-path flow (probe,
     warm-up, measure, log). Entries logged under the override are marked
     forced and never cited as device evidence."""
-    return os.environ.get("RACON_TPU_BENCH_FORCE_DEVICE") == "1"
+    return config.get_bool("RACON_TPU_BENCH_FORCE_DEVICE")
 
 
 def device_healthy(timeout_s: int = 120) -> bool:
@@ -208,7 +212,7 @@ def aligner_compiles(timeout_s: int = 600):
     alignment (SAM input) or the engine resolves to host/xla anyway."""
     if INPUT == "sam":
         return None
-    env = os.environ.get("RACON_TPU_DEVICE_ALIGNER", "auto")
+    env = config.get_str("RACON_TPU_DEVICE_ALIGNER")
     if _forced_device() or env not in ("auto", "", "hirschberg"):
         return None
     forced = env == "hirschberg"
@@ -258,7 +262,7 @@ def _aligner_log_value(aligner):
         return "n/a"
     if aligner:
         return aligner
-    env = os.environ.get("RACON_TPU_DEVICE_ALIGNER", "auto")
+    env = config.get_str("RACON_TPU_DEVICE_ALIGNER")
     if env in ("1", "xla"):
         return "xla"
     if env == "hirschberg":
@@ -266,10 +270,9 @@ def _aligner_log_value(aligner):
     return "host"
 
 
-LOG_PATH = os.environ.get(
-    "RACON_TPU_BENCH_LOG",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                 "docs", "device_bench_log.jsonl"))
+LOG_PATH = config.get_raw("RACON_TPU_BENCH_LOG") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "docs", "device_bench_log.jsonl")
 
 
 def log_device_measurement(entry: dict) -> None:
@@ -379,7 +382,7 @@ def main():
         print(f"[bench] cpu: {bp_cpu} bp in {dt_cpu:.1f}s", file=sys.stderr)
         return
 
-    pallas_disabled = os.environ.get("RACON_TPU_PALLAS") == "0"
+    pallas_disabled = config.get_raw("RACON_TPU_PALLAS") == "0"
     if pallas_disabled:
         # Explicit XLA-tier measurement (hw_session bench_sam_xla64):
         # skip the Mosaic probes entirely — they'd compile kernels this
@@ -440,7 +443,7 @@ def main():
         "vs_baseline": round(mbps_tpu / mbps_cpu, 3),
         "pallas": pallas_ok, "kernel": tier or "xla",
         "aligner": _aligner_log_value(aligner),
-        "node_factor": int(os.environ.get("RACON_TPU_NODE_FACTOR", "3")),
+        "node_factor": config.get_int("RACON_TPU_NODE_FACTOR"),
         "tpu_s": round(dt_tpu, 1), "cpu_s": round(dt_cpu, 1),
         "report": rep_tpu,
     })
@@ -472,8 +475,7 @@ def _opportunistic_golden(tier, timeout_s: int = 900):
         return
     tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "racon_tpu", "tools", "pin_device_golden.py")
-    data = os.environ.get("RACON_TPU_TEST_DATA",
-                          "/root/reference/test/data/")
+    data = config.get_str("RACON_TPU_TEST_DATA")
     if not os.path.isdir(data):
         return
     try:

@@ -1,0 +1,98 @@
+"""`python -m racon_tpu.analysis` — run the AST lint and the jaxpr
+audit over the repo; exit non-zero on new (non-baselined) violations.
+
+Wired into tier-1 via tests/test_analysis.py; run it locally before
+sending a change that touches kernels, knobs, or the resilience layer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from . import jaxpr_audit, lint
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m racon_tpu.analysis",
+        description="racon_tpu static analysis: repo-specific AST lint "
+                    "+ abstract jaxpr audit of the device kernel grid")
+    p.add_argument("--repo-root", default=None,
+                   help="repo root (default: auto-detected)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="suppression baseline JSON; violations whose "
+                        "fingerprints it accepts are not reported "
+                        "(default: <repo>/tools/lint_baseline.json if "
+                        "present)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept every current violation into the "
+                        "baseline file and exit 0")
+    p.add_argument("--no-jaxpr", action="store_true",
+                   help="skip the jaxpr audit (AST lint only; fast)")
+    p.add_argument("--no-lint", action="store_true",
+                   help="skip the AST lint (jaxpr audit only)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print every rule id + summary and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        from .rules import ALL_RULES
+        for rule in ALL_RULES:
+            print(f"{rule.id:18s} {rule.doc}")
+        for rid, doc in (
+            ("jaxpr-forbidden-primitive",
+             "no host callbacks / infeed / implicit transfers in "
+             "kernel jaxprs"),
+            ("jaxpr-float64",
+             "no float64 intermediates in kernel jaxprs"),
+            ("recompile-budget",
+             "distinct jit signatures across the kernel grid stay "
+             "within the declared budget"),
+        ):
+            print(f"{rid:18s} {doc}")
+        return 0
+
+    root = args.repo_root or lint.repo_root_for()
+    violations: List[lint.Violation] = []
+    if not args.no_lint:
+        violations.extend(lint.run_lint(root))
+    if not args.no_jaxpr:
+        violations.extend(jaxpr_audit.run_audit())
+
+    baseline_path = args.baseline or os.path.join(
+        root, "tools", "lint_baseline.json")
+    if args.write_baseline:
+        lint.write_baseline(baseline_path, violations)
+        print(f"[analysis] baseline: accepted {len(violations)} "
+              f"violation(s) into {baseline_path}")
+        return 0
+
+    baseline = lint.load_baseline(baseline_path)
+    new = lint.filter_baselined(violations, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "total": len(violations),
+            "baselined": len(violations) - len(new),
+            "new": [vars(v) for v in new],
+        }, indent=2))
+    else:
+        for v in new:
+            print(v.render())
+        n_base = len(violations) - len(new)
+        tail = f" ({n_base} baselined)" if n_base else ""
+        if new:
+            print(f"[analysis] FAIL: {len(new)} violation(s){tail}")
+        else:
+            print(f"[analysis] OK: no new violations{tail}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""Lint rule registry + tiny AST helpers shared by the rules.
+
+Every rule is an object with:
+
+* ``id``    — kebab-case identifier (used in suppressions and baselines)
+* ``doc``   — one-line rationale (rendered by ``--list-rules`` and docs)
+* ``check(ctx: FileContext) -> Iterable[Violation]``
+* optionally ``check_project(project: ProjectContext)`` for cross-file
+  invariants (run once per lint, after the per-file pass)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.lax.scan' for the func of a Call; '' when not a plain
+    name/attribute chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def last_attr(node: ast.AST) -> str:
+    """The final component of a call target ('scan' for jax.lax.scan)."""
+    name = dotted_name(node)
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+from .tracer import TracerLeakRule            # noqa: E402
+from .caching import KernelCacheKeyRule       # noqa: E402
+from .knobs import EnvRegistryRule, KnobDocsRule  # noqa: E402
+from .faultpoints import FaultPointRule       # noqa: E402
+from .excepts import DeviceExceptRule         # noqa: E402
+
+#: All rules, in documentation order.
+ALL_RULES = (
+    TracerLeakRule(),
+    KernelCacheKeyRule(),
+    EnvRegistryRule(),
+    KnobDocsRule(),
+    FaultPointRule(),
+    DeviceExceptRule(),
+)
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -1,0 +1,93 @@
+"""kernel-cache-key: kernel-builder caches must be keyed on device
+topology.
+
+A memoized kernel builder that ignores the device set serves a stale
+sharded/interpreted kernel after the JAX backend is reconfigured — the
+exact ADVICE.md round-5 finding (`_build_kernel_cached` originally keyed
+only on geometry).  The sanctioned patterns are:
+
+* decorate with ``ops.kernel_cache.device_keyed_cache`` (appends
+  ``(len(jax.devices()), platform)`` to the key implicitly), or
+* take explicit ``n_dev`` + ``platform`` parameters (the caller then
+  owns the topology key, as ``_build_kernel_cached`` does), or
+* be nested inside a function that satisfies one of the above (the
+  closure is rebuilt per topology, so inner per-batch caches inherit
+  the key).
+
+The rule fires on any ``functools.lru_cache``-decorated function that
+builds device kernels (name contains "kernel", or its body calls
+``jit`` / ``pallas_call`` / ``shard_map`` / a ``shard_*`` mesh helper)
+and satisfies none of the patterns above.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from ..lint import FileContext, Violation
+from . import last_attr
+
+_DEVICE_COUNT_PARAMS = {"n_dev", "ndev", "n_devices", "num_devices"}
+_PLATFORM_PARAMS = {"platform"}
+_KERNEL_BODY_CALLS = {"jit", "pallas_call", "shard_map"}
+
+
+def _decorator_names(fn) -> Set[str]:
+    names = set()
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        names.add(last_attr(target))
+    return names
+
+
+def _params(fn) -> Set[str]:
+    a = fn.args
+    return {p.arg for p in
+            list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)}
+
+
+def _builds_kernels(fn) -> bool:
+    if "kernel" in fn.name.lower():
+        return True
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = last_attr(node.func)
+            if name in _KERNEL_BODY_CALLS or name.startswith("shard_"):
+                return True
+    return False
+
+
+def _topology_keyed(fn) -> bool:
+    if "device_keyed_cache" in _decorator_names(fn):
+        return True
+    p = _params(fn)
+    return bool(p & _DEVICE_COUNT_PARAMS) and bool(p & _PLATFORM_PARAMS)
+
+
+class KernelCacheKeyRule:
+    id = "kernel-cache-key"
+    doc = ("lru_cache'd kernel builders must key on device topology: use "
+           "device_keyed_cache or explicit n_dev+platform params")
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if "lru_cache" not in _decorator_names(node):
+                continue
+            if not _builds_kernels(node):
+                continue
+            if _topology_keyed(node):
+                continue
+            # nested inside a topology-keyed builder? then the closure is
+            # per-topology and the inner cache inherits the key
+            if any(isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   and _topology_keyed(anc)
+                   for anc in ctx.ancestors(node)):
+                continue
+            yield Violation(
+                self.id, ctx.relpath, node.lineno,
+                f"kernel builder '{node.name}' is lru_cache'd without a "
+                f"device-topology key; use ops.kernel_cache."
+                f"device_keyed_cache or take n_dev+platform params")

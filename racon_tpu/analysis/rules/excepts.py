@@ -1,0 +1,58 @@
+"""device-except: no silent broad excepts around device seams.
+
+A bare ``except:`` (or an undocumented ``except Exception:``) around a
+device call swallows the exact signal the degradation lattice needs to
+retry / bisect / demote — work silently disappears instead of being
+re-served by a lower tier.  The repo convention: every deliberate broad
+catch at a lattice seam carries a ``# noqa: BLE001`` marker with a
+one-phrase justification on the same line, making each seam searchable
+and reviewed.
+
+* bare ``except:`` — violation anywhere in the package;
+* ``except Exception`` / ``except BaseException`` in the device layers
+  (``racon_tpu/ops/``, ``racon_tpu/resilience/``, ``racon_tpu/parallel/``)
+  without the ``noqa: BLE001`` marker — violation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..lint import FileContext, Violation
+from . import last_attr
+
+_DEVICE_LAYERS = (("ops",), ("resilience",), ("parallel",))
+_MARKER = "noqa: BLE001"
+
+
+class DeviceExceptRule:
+    id = "device-except"
+    doc = ("no bare except; broad except in device layers must carry "
+           "'# noqa: BLE001' documenting the lattice seam")
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        device_layer = any(ctx.in_package(*p) for p in _DEVICE_LAYERS)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield Violation(
+                    self.id, ctx.relpath, node.lineno,
+                    "bare `except:` swallows the failure signal the "
+                    "degradation lattice steps on; catch a type")
+                continue
+            if not device_layer:
+                continue
+            names = [last_attr(node.type)] if not isinstance(
+                node.type, ast.Tuple) else [last_attr(e)
+                                            for e in node.type.elts]
+            if any(n in ("Exception", "BaseException") for n in names):
+                line = ctx.lines[node.lineno - 1] \
+                    if node.lineno <= len(ctx.lines) else ""
+                if _MARKER not in line:
+                    yield Violation(
+                        self.id, ctx.relpath, node.lineno,
+                        "broad except at a device seam without the "
+                        "documented lattice-boundary marker "
+                        "(# noqa: BLE001 — reason)")

@@ -1,0 +1,100 @@
+"""env-registry / knob-docs: RACON_TPU_* knobs are registered, read
+through racon_tpu.config, and documented.
+
+A scattered ``os.environ.get("RACON_TPU_…")`` read is invisible to the
+stale-knob check, undocumentable by tooling, and untypo-checkable — the
+round-5 serving-mix finding started exactly there.  `racon_tpu/config.py`
+is the single sanctioned reader; this pair of rules enforces both
+directions:
+
+* **env-registry** (per file): any ``os.environ`` / ``os.getenv`` READ
+  of a RACON_TPU name outside config.py is a violation (writes —
+  assignment / ``setdefault`` with a value — stay allowed: tools pin
+  knobs for subprocesses).  Literal knob names passed to ``config.get_*``
+  must exist in the registry (catches typos at lint time, not at 3am).
+
+* **knob-docs** (project): every registered knob appears in README.md's
+  configuration section.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..lint import FileContext, ProjectContext, Violation
+from . import dotted_name, str_const
+
+_PREFIX = "RACON_TPU_"
+_READ_FUNCS = {"os.environ.get", "environ.get", "os.getenv", "getenv"}
+_CONFIG_GETTERS = {"get_raw", "get_str", "get_int", "get_float",
+                   "get_bool", "is_set"}
+
+
+def _registry():
+    from ... import config
+    return config.KNOBS
+
+
+class EnvRegistryRule:
+    id = "env-registry"
+    doc = ("RACON_TPU_* env reads must go through racon_tpu.config; "
+           "literal knob names must be registered")
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        if ctx.relpath == "racon_tpu/config.py":
+            return
+        knobs = _registry()
+        for node in ast.walk(ctx.tree):
+            # os.environ["RACON_TPU_X"] in Load context
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load):
+                base = dotted_name(node.value)
+                key = str_const(node.slice)
+                if base in ("os.environ", "environ") and key and \
+                        key.startswith(_PREFIX):
+                    yield Violation(
+                        self.id, ctx.relpath, node.lineno,
+                        f"direct os.environ read of {key}; use "
+                        f"racon_tpu.config.get_*({key!r})")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            func = dotted_name(node.func)
+            if func in _READ_FUNCS and node.args:
+                key = str_const(node.args[0])
+                if key and key.startswith(_PREFIX):
+                    yield Violation(
+                        self.id, ctx.relpath, node.lineno,
+                        f"direct os.environ read of {key}; use "
+                        f"racon_tpu.config.get_*({key!r})")
+            # config.get_*("RACON_TPU_TYPO") — typo'd literal knob name
+            elif func.rsplit(".", 1)[-1] in _CONFIG_GETTERS and node.args:
+                key = str_const(node.args[0])
+                if key and key.startswith(_PREFIX) and key not in knobs:
+                    yield Violation(
+                        self.id, ctx.relpath, node.lineno,
+                        f"config read of unregistered knob {key}; "
+                        f"declare it in racon_tpu/config.py")
+
+
+class KnobDocsRule:
+    id = "knob-docs"
+    doc = "every registered RACON_TPU_* knob is documented in README.md"
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        return ()
+
+    def check_project(self, project: ProjectContext) -> List[Violation]:
+        readme = project.read_text("README.md")
+        if readme is None:
+            return [Violation(self.id, "README.md", 0,
+                              "README.md not found; knob table missing")]
+        out = []
+        for name in _registry():
+            if name not in readme:
+                out.append(Violation(
+                    self.id, "racon_tpu/config.py", 0,
+                    f"registered knob {name} is not documented in "
+                    f"README.md"))
+        return out

@@ -1,0 +1,219 @@
+"""tracer-leak: no host syncs / host materialization inside traced code.
+
+A jit- or Pallas-traced region runs against abstract tracers; calling
+``float()`` / ``int()`` / ``bool()`` on a traced value, ``.item()``,
+``np.asarray``/``np.array``, or branching with a Python ``if`` on a
+traced expression forces a host sync (ConcretizationError at best, a
+silent device->host transfer + recompile at worst).  The hot path must
+stay free of both (AnySeq/GPU makes the same point for alignment
+kernels; see PAPERS.md).
+
+Detection: a function is a *traced region* when it
+
+* is decorated with ``jit`` / ``jax.jit`` / ``functools.partial(jit, …)``, or
+* is referenced by name inside a call to one of the trace entry points
+  (``jit``, ``vmap``, ``pmap``, ``pallas_call``, ``shard_map``,
+  ``scan``, ``while_loop``, ``fori_loop``, ``cond``, ``switch``,
+  ``checkpoint``, ``remat``).
+
+Inside a traced region (nested defs included) the rule flags:
+
+* ``float(x)`` / ``int(x)`` / ``bool(x)`` where x mentions a parameter
+  of the traced function or a jnp/lax call — a concretization;
+* any ``.item()`` call — always a device sync;
+* ``np.asarray`` / ``np.array`` / ``np.copy`` on anything — host
+  materialization of a tracer;
+* an ``if`` whose test mentions a parameter of the enclosing traced
+  function or a jnp/lax call — data-dependent Python control flow
+  (use ``jnp.where`` / ``lax.cond``).
+
+Static py-level conditionals on closure config (e.g. ``if interpret:``)
+do not fire: closure variables are not parameters of the traced region.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from ..lint import FileContext, Violation
+from . import last_attr
+
+TRACE_ENTRY_POINTS = {
+    "jit", "vmap", "pmap", "pallas_call", "shard_map", "scan",
+    "while_loop", "fori_loop", "cond", "switch", "checkpoint", "remat",
+}
+
+#: numpy-namespace calls that materialize tracers on the host
+_HOST_MATERIALIZERS = {"np.asarray", "np.array", "np.copy",
+                       "numpy.asarray", "numpy.array", "numpy.copy",
+                       "onp.asarray", "onp.array"}
+
+_CONCRETIZERS = {"float", "int", "bool"}
+
+
+def _decorated_traced(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if last_attr(target) == "jit":
+            return True
+        # functools.partial(jax.jit, ...) / partial(jit, ...)
+        if isinstance(dec, ast.Call) and last_attr(dec.func) == "partial":
+            if any(last_attr(a) == "jit" for a in dec.args):
+                return True
+    return False
+
+
+def _mentions_jax_call(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = last_attr(sub.func)
+            dotted_first = ""
+            f = sub.func
+            while isinstance(f, ast.Attribute):
+                f = f.value
+            if isinstance(f, ast.Name):
+                dotted_first = f.id
+            if dotted_first in ("jnp", "lax") or (
+                    dotted_first == "jax" and name):
+                return True
+    return False
+
+
+def _traceable_names(arg: ast.AST) -> Set[str]:
+    """Function names an entry-point argument hands over FOR TRACING: a
+    bare reference, names inside a lambda body, or the callable args of
+    ``functools.partial``.  Names inside other call expressions (e.g.
+    ``mesh=device_mesh()``) are evaluated eagerly at build time, not
+    traced, and must not mark that function as a traced region."""
+    out: Set[str] = set()
+    if isinstance(arg, ast.Name):
+        out.add(arg.id)
+    elif isinstance(arg, ast.Lambda):
+        for sub in ast.walk(arg.body):
+            if isinstance(sub, ast.Name):
+                out.add(sub.id)
+    elif isinstance(arg, ast.Call) and last_attr(arg.func) == "partial":
+        for a in arg.args:
+            if isinstance(a, ast.Name):
+                out.add(a.id)
+    return out
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _mentions_any(node: ast.AST, names: Set[str]) -> bool:
+    """A Name in `names` occurs outside a `len(...)` argument — len() of
+    a traced array is its static leading dim, not a data-dependent
+    read, so `if len(xs) % 2:` style structural branches stay legal."""
+    def walk(n: ast.AST) -> bool:
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "len":
+            return False
+        if isinstance(n, ast.Name) and n.id in names:
+            return True
+        return any(walk(c) for c in ast.iter_child_nodes(n))
+    return walk(node)
+
+
+class TracerLeakRule:
+    id = "tracer-leak"
+    doc = ("no float()/int()/bool()/.item()/np.asarray or data-dependent "
+           "`if` on traced values inside jit/Pallas regions")
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        traced_fns = self._traced_functions(ctx)
+        out: List[Violation] = []
+        for fn in traced_fns:
+            params = _param_names(fn)
+            # include nested defs' params: their args are traced too
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and sub is not fn:
+                    params |= _param_names(sub)
+            for node in ast.walk(fn):
+                out.extend(self._check_node(ctx, fn, node, params))
+        # de-dup: nested traced fns are walked once per enclosing region
+        seen = set()
+        uniq = []
+        for v in out:
+            key = (v.line, v.message)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(v)
+        return uniq
+
+    # -- helpers -----------------------------------------------------------
+    def _traced_functions(self, ctx: FileContext) -> List[ast.AST]:
+        defs = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+        traced: List[ast.AST] = []
+        names_referenced: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if last_attr(node.func) in TRACE_ENTRY_POINTS:
+                for arg in list(node.args) + [kw.value for kw in
+                                              node.keywords]:
+                    names_referenced |= _traceable_names(arg)
+        for name, fns in defs.items():
+            for fn in fns:
+                if _decorated_traced(fn) or name in names_referenced:
+                    traced.append(fn)
+        return traced
+
+    def _check_node(self, ctx: FileContext, fn, node,
+                    params: Set[str]) -> Iterable[Violation]:
+        rel, rule = ctx.relpath, self.id
+        if isinstance(node, ast.Call):
+            name = last_attr(node.func)
+            dotted = name
+            f = node.func
+            chain = []
+            while isinstance(f, ast.Attribute):
+                chain.append(f.attr)
+                f = f.value
+            if isinstance(f, ast.Name):
+                dotted = ".".join([f.id] + list(reversed(chain)))
+            # .item() on anything — including call results like
+            # jnp.sum(x).item(), whose chain roots at a Call and so has
+            # no dotted name
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item":
+                yield Violation(rule, rel, node.lineno,
+                                f".item() inside traced region "
+                                f"'{fn.name}' forces a device sync")
+                return
+            if dotted in _HOST_MATERIALIZERS:
+                yield Violation(rule, rel, node.lineno,
+                                f"{dotted}() inside traced region "
+                                f"'{fn.name}' materializes a tracer on "
+                                f"the host")
+                return
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in _CONCRETIZERS and node.args):
+                arg = node.args[0]
+                if _mentions_any(arg, params) or _mentions_jax_call(arg):
+                    yield Violation(
+                        rule, rel, node.lineno,
+                        f"{node.func.id}() on a traced value inside "
+                        f"'{fn.name}' concretizes the tracer")
+                return
+        if isinstance(node, ast.If):
+            if _mentions_any(node.test, params) or \
+                    _mentions_jax_call(node.test):
+                yield Violation(
+                    rule, rel, node.lineno,
+                    f"data-dependent `if` on a traced value inside "
+                    f"'{fn.name}' (use jnp.where / lax.cond)")

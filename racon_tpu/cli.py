@@ -78,6 +78,15 @@ def main(argv=None) -> int:
         print(e, file=sys.stderr)
         return 1
 
+    # Typo'd knobs must not be silently ignored: a RACON_TPU_* var the
+    # registry doesn't know is almost always a misspelled real one.
+    from . import config
+    stale = config.unknown_env_knobs()
+    if stale:
+        print(f"[racon_tpu] WARNING: unknown RACON_TPU_* environment "
+              f"variable(s) ignored: {', '.join(stale)} (known knobs: "
+              f"see README.md)", file=sys.stderr)
+
     if args.tpu:
         # Validate device-path env config up front — a broad ValueError
         # catch around the whole run would also swallow real bugs'

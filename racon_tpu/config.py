@@ -1,0 +1,164 @@
+"""Central registry of every ``RACON_TPU_*`` environment knob.
+
+Every environment variable the runtime, tools, benchmarks, or tests read
+is declared here — name, default, type, and a docstring — and read
+through the typed accessors below.  This file is the ground truth for:
+
+* the ``env-registry`` lint rule (``racon_tpu/analysis``): any
+  ``os.environ`` / ``os.getenv`` read of a ``RACON_TPU_*`` name outside
+  this module is a violation, so a knob cannot be introduced without a
+  registered name and documentation;
+* the ``knob-docs`` lint rule: every registered knob must appear in
+  README.md's configuration table;
+* the run report's stale-knob check (``unknown_env_knobs``): variables
+  set in the environment with the ``RACON_TPU_`` prefix but unknown to
+  this registry are surfaced in ``Polisher.report`` instead of being
+  silently ignored — a typo'd knob is visible, not a no-op.
+
+Only the stdlib is imported so this module is importable from anywhere
+(including ``racon_tpu/__init__`` before jax initializes).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+PREFIX = "RACON_TPU_"
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One registered environment knob."""
+
+    name: str          # full variable name, RACON_TPU_… prefix included
+    default: Optional[str]  # raw default ('' / None = unset semantics)
+    kind: str          # 'str' | 'int' | 'float' | 'bool' — documentation
+    doc: str           # one-line effect description (README table text)
+    scope: str = "runtime"   # 'runtime' | 'tools' | 'bench' | 'test'
+
+
+def _k(name: str, default: Optional[str], kind: str, doc: str,
+       scope: str = "runtime") -> Knob:
+    assert name.startswith(PREFIX), name
+    return Knob(name, default, kind, doc, scope)
+
+
+#: The registry.  Order matters only for documentation output.
+KNOBS: Dict[str, Knob] = {k.name: k for k in (
+    # -- device-path (production) knobs -----------------------------------
+    _k("RACON_TPU_PALLAS", None, "bool",
+       "fused Pallas kernels vs the XLA twin (default: 1 on TPU, 0 "
+       "elsewhere)"),
+    _k("RACON_TPU_POA_KERNEL", "ls", "str",
+       "consensus kernel tier: 'ls' (lane-lockstep) or 'v2' (one "
+       "window/program)"),
+    _k("RACON_TPU_DEVICE_ALIGNER", "auto", "str",
+       "phase-1 aligner: auto | hirschberg | 1/xla | 0/host"),
+    _k("RACON_TPU_BATCH_WINDOWS", None, "int",
+       "windows per device batch (default: 64 on TPU, 4 elsewhere)"),
+    _k("RACON_TPU_PIPELINE_DEPTH", "2", "int",
+       "in-flight device chunks (host packs ahead of execution)"),
+    _k("RACON_TPU_NODE_FACTOR", "3", "int",
+       "POA graph node capacity = factor x window length"),
+    _k("RACON_TPU_ALIGN_COHORT", None, "int",
+       "phase-1 jobs materialized per device cohort (default 64)"),
+    _k("RACON_TPU_COMPILE_CACHE", None, "str",
+       "persistent XLA compilation cache directory (default: uid-keyed "
+       "~/.cache path)"),
+    _k("RACON_TPU_FORCE_CPU", None, "bool",
+       "force the virtual-CPU backend before jax initializes (tools)",
+       scope="tools"),
+    # -- resilience knobs -------------------------------------------------
+    _k("RACON_TPU_TIER_RETRIES", "1", "int",
+       "extra attempts per kernel tier before bisecting/demoting"),
+    _k("RACON_TPU_DEVICE_TIMEOUT", "0", "float",
+       "per-device-call watchdog in seconds (0 = off)"),
+    _k("RACON_TPU_FAULT", None, "str",
+       "deterministic fault injection spec (see resilience/faults.py)"),
+    _k("RACON_TPU_REPORT", None, "str",
+       "write the JSON run report to this path after every polish"),
+    # -- test / bench knobs ----------------------------------------------
+    _k("RACON_TPU_HW_TESTS", None, "bool",
+       "assert exact on-hardware pins against a real TPU backend",
+       scope="test"),
+    _k("RACON_TPU_FULL_GOLDEN", None, "bool",
+       "run the slow golden scenarios", scope="test"),
+    _k("RACON_TPU_TEST_DATA", "/root/reference/test/data/", "str",
+       "directory holding the lambda-phage fixture data", scope="test"),
+    _k("RACON_TPU_BENCH_MBP", "0.5", "float",
+       "benchmark workload size in polished megabases", scope="bench"),
+    _k("RACON_TPU_BENCH_INPUT", "paf", "str",
+       "benchmark overlap format: paf | sam", scope="bench"),
+    _k("RACON_TPU_BENCH_PROFILE", "ont", "str",
+       "benchmark read profile: ont | sr", scope="bench"),
+    _k("RACON_TPU_BENCH_LOG", None, "str",
+       "append one bench JSON line per run to this file", scope="bench"),
+    _k("RACON_TPU_BENCH_FORCE_DEVICE", None, "bool",
+       "treat the current backend as the measured device (CPU rehearsal)",
+       scope="bench"),
+)}
+
+
+# --------------------------------------------------------------------------
+# typed accessors — the only sanctioned way to READ a RACON_TPU_* variable
+# --------------------------------------------------------------------------
+
+def _knob(name: str) -> Knob:
+    try:
+        return KNOBS[name]
+    except KeyError:
+        raise KeyError(
+            f"{name!r} is not a registered knob; add it to "
+            f"racon_tpu/config.py (and README.md)") from None
+
+
+def get_raw(name: str) -> Optional[str]:
+    """The raw environment value, or the registered default (may be
+    None).  Exists so call sites with bespoke parsing keep byte-identical
+    behavior while still going through the registry."""
+    k = _knob(name)
+    return os.environ.get(name, k.default)
+
+
+def get_str(name: str) -> str:
+    v = get_raw(name)
+    return "" if v is None else v
+
+
+def get_int(name: str) -> int:
+    """int(value); raises ValueError on garbage exactly like the direct
+    int(os.environ.get(...)) reads this replaced."""
+    v = get_raw(name)
+    if v is None:
+        raise KeyError(f"{name} has no value and no registered default")
+    return int(v)
+
+
+def get_float(name: str) -> float:
+    v = get_raw(name)
+    if v is None:
+        raise KeyError(f"{name} has no value and no registered default")
+    return float(v)
+
+
+def get_bool(name: str) -> bool:
+    """True iff the variable is set to '1' (the repo-wide convention)."""
+    return get_raw(name) == "1"
+
+
+def is_set(name: str) -> bool:
+    """Whether the variable is present in the environment at all."""
+    _knob(name)
+    return name in os.environ
+
+
+def unknown_env_knobs(environ=None) -> List[str]:
+    """RACON_TPU_* variables set in the environment but absent from the
+    registry — almost always a typo'd knob that would otherwise be
+    silently ignored.  Surfaced in the run report (see
+    resilience/report.py)."""
+    env = os.environ if environ is None else environ
+    return sorted(v for v in env
+                  if v.startswith(PREFIX) and v not in KNOBS)

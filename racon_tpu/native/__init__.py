@@ -119,14 +119,16 @@ def load() -> ctypes.CDLL:
     lib.rt_pipeline_num_windows.argtypes = [ctypes.c_void_p]
 
     lib.rt_pipeline_window_info.restype = None
-    lib.rt_pipeline_window_info.argtypes = [ctypes.c_void_p, ctypes.c_uint64, u64p]
+    lib.rt_pipeline_window_info.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, u64p]
 
     lib.rt_pipeline_window_export.restype = None
     lib.rt_pipeline_window_export.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, u8p, u8p, u32p, u32p, u32p, u8p, u8p]
 
     lib.rt_pipeline_consensus_cpu_one.restype = ctypes.c_int
-    lib.rt_pipeline_consensus_cpu_one.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.rt_pipeline_consensus_cpu_one.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64]
 
     lib.rt_pipeline_set_consensus.restype = None
     lib.rt_pipeline_set_consensus.argtypes = [
@@ -137,10 +139,12 @@ def load() -> ctypes.CDLL:
     lib.rt_pipeline_stitch.argtypes = [ctypes.c_void_p, ctypes.c_int]
 
     lib.rt_pipeline_result_name.restype = ctypes.c_void_p
-    lib.rt_pipeline_result_name.argtypes = [ctypes.c_void_p, ctypes.c_uint64, u64p]
+    lib.rt_pipeline_result_name.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, u64p]
 
     lib.rt_pipeline_result_data.restype = ctypes.c_void_p
-    lib.rt_pipeline_result_data.argtypes = [ctypes.c_void_p, ctypes.c_uint64, u64p]
+    lib.rt_pipeline_result_data.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, u64p]
 
     lib.rt_pipeline_get_consensus.restype = ctypes.c_void_p
     lib.rt_pipeline_get_consensus.argtypes = [ctypes.c_void_p,

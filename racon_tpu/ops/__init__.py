@@ -4,6 +4,8 @@ and fall back to the host for anything outside device limits."""
 
 import os
 
+from .. import config
+
 
 def enable_compilation_cache() -> None:
     """Persist XLA compilations across processes (kernel geometries are
@@ -12,9 +14,8 @@ def enable_compilation_cache() -> None:
     try:
         import jax
 
-        cache_dir = os.environ.get(
-            "RACON_TPU_COMPILE_CACHE",
-            os.path.join(os.path.expanduser("~"), ".cache", "racon_tpu_xla"))
+        cache_dir = config.get_raw("RACON_TPU_COMPILE_CACHE") or os.path.join(
+            os.path.expanduser("~"), ".cache", "racon_tpu_xla")
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)

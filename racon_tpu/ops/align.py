@@ -19,19 +19,24 @@ aligner, with accuracy pinned by the golden tests.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .encoding import encode
+from .kernel_cache import device_keyed_cache
 
 INF = jnp.int32(1 << 28)
 
 # (max sequence length, band width) buckets; larger pairs go to the host.
 BUCKETS = ((1024, 256), (2048, 512), (4096, 1024), (8192, 2048))
 MAX_DEVICE_LEN = BUCKETS[-1][0]
+
+#: Declared compile budget for the aligner: one jit signature per
+#: (cap, band) bucket at the nominal batch.  A deliberate literal (see
+#: POA_RECOMPILE_BUDGET in poa_driver.py): adding a bucket without
+#: revisiting this number fails the jaxpr audit.
+ALIGN_RECOMPILE_BUDGET = 4
 
 
 def device_eligible(q_len: int, t_len: int) -> bool:
@@ -52,7 +57,7 @@ def _bucket_for(size: int):
     raise ValueError(size)
 
 
-@functools.lru_cache(maxsize=16)
+@device_keyed_cache(maxsize=16)
 def build_align_kernel(cap: int, band: int):
     """jit kernel over a batch: returns (moves-free) ops + lengths."""
     K = band

@@ -7,9 +7,10 @@ rejection statuses src/cuda/cudaaligner.cpp:63-71).
 
 from __future__ import annotations
 
-import os
 import sys
 import time
+
+from .. import config
 
 
 def _on_tpu() -> bool:
@@ -30,7 +31,7 @@ def _engine() -> str:
     kernel, small pairs only). A device-engine failure degrades to the
     host aligner for the remaining jobs (see run_alignment_phase).
     """
-    env = os.environ.get("RACON_TPU_DEVICE_ALIGNER", "auto")
+    env = config.get_str("RACON_TPU_DEVICE_ALIGNER")
     if env in ("auto", ""):
         return "hirschberg" if _on_tpu() else "host"
     if env in ("0", "host"):

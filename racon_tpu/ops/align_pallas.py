@@ -36,13 +36,14 @@ reference's per-GPU aligner batches
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import config
 from .encoding import encode
+from .kernel_cache import device_keyed_cache
 
 INF = 1 << 28
 BASE_ROWS = 256          # subproblems at or below this row count run the
@@ -80,7 +81,7 @@ def _shard_over_mesh(build_local, batch, n_in, n_out):
 # distance-only kernels
 # ---------------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=64)
+@device_keyed_cache(maxsize=64)
 def _build_edge_kernel(rcap: int, K: int, backward: bool,
                        interpret: bool = False):
     """Batched banded DP over up to `rcap` rows; returns the last row.
@@ -228,7 +229,7 @@ def _build_edge_kernel(rcap: int, K: int, backward: bool,
 # base-case kernel: full moves + in-kernel traceback
 # ---------------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=32)
+@device_keyed_cache(maxsize=32)
 def _build_base_kernel(K: int, interpret: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -536,7 +537,8 @@ from .align import ops_to_cigar  # same 0=M/1=I/2=D convention
 
 def cohort_size(default: int = 64) -> int:
     """Jobs materialized per device cohort (RACON_TPU_ALIGN_COHORT)."""
-    return max(1, int(os.environ.get("RACON_TPU_ALIGN_COHORT", default)))
+    env = config.get_raw("RACON_TPU_ALIGN_COHORT")
+    return max(1, int(env if env is not None else default))
 
 
 def run_jobs(pipeline, jobs, cohort: int = None, report=None) -> int:

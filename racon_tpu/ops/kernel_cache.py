@@ -1,0 +1,45 @@
+"""Topology-keyed memoization for kernel builders.
+
+A plain ``functools.lru_cache`` on a kernel builder is a latent bug: the
+built object bakes in the device set (sharding meshes, interpret-mode
+decisions), so reconfiguring JAX devices after a first build would serve
+a stale sharded/interpreted kernel (the round-5 ADVICE finding on
+``_build_kernel_cached``).  ``device_keyed_cache`` is the sanctioned
+replacement: it appends ``(len(jax.devices()), platform)`` to the cache
+key implicitly, keeping builder signatures unchanged.
+
+The ``kernel-cache-key`` lint rule (racon_tpu/analysis) enforces that
+every cached kernel builder either uses this decorator or takes explicit
+``n_dev`` + ``platform`` parameters.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def device_keyed_cache(maxsize: int = 64):
+    """`functools.lru_cache` whose key implicitly includes the device
+    topology (device count + platform) at call time.
+
+    Exposes ``cache_clear`` / ``cache_info`` like lru_cache.  jax is
+    imported lazily at first call so decorated builders stay importable
+    before any backend configuration (e.g. the test suite's forced CPU
+    mesh)."""
+    def deco(build):
+        @functools.lru_cache(maxsize=maxsize)
+        def cached(_n_dev, _platform, *args, **kwargs):
+            return build(*args, **kwargs)
+
+        @functools.wraps(build)
+        def wrapper(*args, **kwargs):
+            import jax
+
+            devs = jax.devices()
+            return cached(len(devs), devs[0].platform, *args, **kwargs)
+
+        wrapper.cache_clear = cached.cache_clear
+        wrapper.cache_info = cached.cache_info
+        wrapper.__wrapped__ = build
+        return wrapper
+    return deco

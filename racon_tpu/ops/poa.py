@@ -34,11 +34,12 @@ buckets windows to bound padding waste.
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+
+from .kernel_cache import device_keyed_cache
 
 NEG = jnp.int32(-(1 << 28))
 KEY_INF = jnp.float32(jnp.inf)
@@ -167,7 +168,8 @@ def _traceback(cfg: PoaConfig, g: Graph, H, seq, sub_mask, order, n_sub, L):
                        jnp.int32(cfg.match), jnp.int32(cfg.mismatch))
 
         diag_ok = valid & (j > 0) & (prow_jm1 + sc == cur)
-        diag_virt = ~any_valid & (j > 0) & (H[0, jnp.maximum(j - 1, 0)] + sc == cur)
+        diag_virt = ~any_valid & (j > 0) & (
+            H[0, jnp.maximum(j - 1, 0)] + sc == cur)
         any_diag = diag_ok.any() | diag_virt
         diag_slot = jnp.argmax(diag_ok)
         diag_pred = jnp.where(diag_ok.any(), srcs[diag_slot], -1)
@@ -404,7 +406,7 @@ def _polish_window(cfg: PoaConfig, bb_codes, bb_w, bb_len, n_layers,
     return cons_base, cons_cov, cons_len, g.failed, g.n
 
 
-@functools.lru_cache(maxsize=32)
+@device_keyed_cache(maxsize=32)
 def build_poa_kernel(cfg: PoaConfig):
     """jit-compiled batch kernel: all inputs have a leading batch dim."""
 

@@ -20,7 +20,6 @@ whole run a tier.  Every seam carries a named fault-injection point
 from __future__ import annotations
 
 import functools
-import os
 import sys
 import time
 from collections import deque
@@ -28,6 +27,7 @@ from typing import List
 
 import numpy as np
 
+from .. import config
 from ..resilience import faults
 from ..resilience import lattice as rl
 from ..resilience.report import PhaseReport
@@ -39,14 +39,29 @@ DEPTH_BUCKETS = (8, 32, DEPTH_CAP)
 
 _PALLAS_KINDS = ("ls", "v2")
 
+#: The window lengths the static jaxpr audit traces the consensus kernel
+#: grid at: the CLI default (-w 500) and the large-window scenario
+#: (-w 1000).  Each maps to its 128-lane class exactly as
+#: run_consensus_phase buckets real windows.
+AUDIT_WINDOW_LENGTHS = (500, 1000)
+
+#: Declared compile budget for the audited POA grid: one jit signature
+#: per (depth bucket, window class) — len(DEPTH_BUCKETS) x
+#: len(AUDIT_WINDOW_LENGTHS) = 6.  A deliberate literal, not a product:
+#: widening DEPTH_BUCKETS, the audited window set, or any geometry
+#: change that splits signatures must consciously revisit this number or
+#: the jaxpr audit (racon_tpu/analysis) fails tier-1 — silent recompile
+#: blow-ups are the single biggest TPU serving-latency cliff.
+POA_RECOMPILE_BUDGET = 6
+
 
 def _pipeline_depth() -> int:
     """How many packed chunks may be in flight on the device at once."""
-    return max(1, int(os.environ.get("RACON_TPU_PIPELINE_DEPTH", "2")))
+    return max(1, config.get_int("RACON_TPU_PIPELINE_DEPTH"))
 
 
 def _batch_size() -> int:
-    env = os.environ.get("RACON_TPU_BATCH_WINDOWS")
+    env = config.get_raw("RACON_TPU_BATCH_WINDOWS")
     if env:
         return max(1, int(env))
     import jax
@@ -61,7 +76,7 @@ def _kernel_kind() -> str:
     Either degrades through the lattice (ls -> v2 -> xla -> host) on
     Mosaic failure.
     """
-    k = os.environ.get("RACON_TPU_POA_KERNEL", "ls")
+    k = config.get_str("RACON_TPU_POA_KERNEL")
     if k not in _PALLAS_KINDS:
         raise ValueError(
             f"RACON_TPU_POA_KERNEL must be 'ls' or 'v2', got {k!r}")
@@ -88,7 +103,7 @@ def _node_factor() -> int:
     measures factor 4 (VMEM fits per docs/roadmap.md) for a same-session
     pin refresh — the reference's per-entry capacity rejection is the
     analogous knob (/root/reference/src/cuda/cudabatch.cpp:141-160)."""
-    return max(1, int(os.environ.get("RACON_TPU_NODE_FACTOR", "3")))
+    return max(1, config.get_int("RACON_TPU_NODE_FACTOR"))
 
 
 def window_class(bb_len: int) -> int:
@@ -438,7 +453,7 @@ def _drain(pipeline, pending, trim, stats, fallback, B, dead_geoms,
 
 
 def _use_pallas() -> bool:
-    env = os.environ.get("RACON_TPU_PALLAS")
+    env = config.get_raw("RACON_TPU_PALLAS")
     if env is not None:
         return env == "1"
     import jax

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .kernel_cache import device_keyed_cache
 from .poa import PoaConfig
 
 NEG = -(1 << 28)
@@ -63,7 +64,7 @@ def blocked_width(n: int) -> int:
     return _round_up((n + 7) // 8, 128)
 
 
-@functools.lru_cache(maxsize=32)
+@device_keyed_cache(maxsize=32)
 def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
     N = cfg.max_nodes
     L = cfg.max_len
@@ -291,7 +292,8 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 V = jnp.where(choose_diag, diag, up)
                 vmove = jnp.where(choose_diag, 4 * Ssh, 1 + 4 * Pslot)
                 row = cummaxj(V - gvec) + gvec
-                mv = jnp.where(row > V, 2, vmove)  # left only if strictly better
+                # left only if strictly better
+                mv = jnp.where(row > V, 2, vmove)
                 H[pl.ds(u + 1, 1)] = row.reshape(1, 8, JW)
                 MV[pl.ds(u + 1, 1)] = mv.reshape(1, 8, JW)
                 rmwn(esc, r, loadj(row, Ln))

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .kernel_cache import device_keyed_cache
 from .poa import PoaConfig
 
 NEG = -(1 << 28)
@@ -59,7 +60,7 @@ def _round_up(x, m):
     return (x + m - 1) // m * m
 
 
-@functools.lru_cache(maxsize=32)
+@device_keyed_cache(maxsize=32)
 def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False):
     N = cfg.max_nodes
     L = cfg.max_len

@@ -4,4 +4,5 @@ multi-GPU batch striping, src/cuda/cudapolisher.cpp:165-180,228-240, maps to
 batch-dim sharding over ICI; multi-host scales by sharding contigs/windows
 over DCN with an ordered host gather, no collectives needed)."""
 
-from .mesh import device_mesh, divisible_batch, shard_batch_kernel  # noqa: F401
+from .mesh import (  # noqa: F401
+    device_mesh, divisible_batch, shard_batch_kernel)

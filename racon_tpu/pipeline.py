@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import ctypes
 from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 import numpy as np
 
@@ -124,7 +124,8 @@ class Pipeline:
 
     def export_window(self, i: int) -> WindowExport:
         faults.check("window.export", (i,))
-        n_seqs, bb_len, rank, is_tgs, layer_bytes, target_id = self.window_info(i)
+        (n_seqs, bb_len, rank, is_tgs, layer_bytes,
+         target_id) = self.window_info(i)
         k = n_seqs - 1
         bb = np.zeros(bb_len, dtype=np.uint8)
         bbw = np.zeros(bb_len, dtype=np.uint8)

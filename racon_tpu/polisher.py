@@ -85,7 +85,9 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
                     backend: str = "cpu", **kwargs):
     """Factory. backend: 'cpu' (host oracle) or 'tpu' (device batched)."""
     if backend == "cpu":
-        return CpuPolisher(sequences_path, overlaps_path, target_path, **kwargs)
+        return CpuPolisher(sequences_path, overlaps_path, target_path,
+                           **kwargs)
     if backend == "tpu":
-        return TpuPolisher(sequences_path, overlaps_path, target_path, **kwargs)
+        return TpuPolisher(sequences_path, overlaps_path, target_path,
+                           **kwargs)
     raise ValueError(f"unknown backend: {backend!r}")

@@ -37,10 +37,11 @@ so consecutive runs in one process see identical firing schedules.
 
 from __future__ import annotations
 
-import os
 import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
+
+from .. import config
 
 ENV = "RACON_TPU_FAULT"
 
@@ -187,7 +188,7 @@ _cached_plan: Optional[FaultPlan] = None
 
 def _plan() -> Optional[FaultPlan]:
     global _cached_env, _cached_plan
-    env = os.environ.get(ENV, "")
+    env = config.get_str(ENV)
     if env != _cached_env:
         _cached_env = env
         _cached_plan = FaultPlan(parse_spec(env)) if env else None
@@ -196,7 +197,7 @@ def _plan() -> Optional[FaultPlan]:
 
 def active_spec() -> str:
     """The armed spec string ('' when fault injection is off)."""
-    return os.environ.get(ENV, "")
+    return config.get_str(ENV)
 
 
 def check(point: str, windows: Optional[Sequence[int]] = None) -> None:
@@ -220,6 +221,6 @@ def reset() -> None:
 def validate_env() -> None:
     """Eagerly parse RACON_TPU_FAULT; raises ValueError when malformed.
     The CLI calls this up front so a bad spec is a single-line error."""
-    env = os.environ.get(ENV, "")
+    env = config.get_str(ENV)
     if env:
         parse_spec(env)

@@ -31,10 +31,11 @@ Failure taxonomy the drivers map onto this module:
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Callable, List, Optional, Sequence, Tuple
+
+from .. import config
 
 #: Consensus kernel tiers, best first.  "host" is the floor: windows are
 #: re-polished one-by-one by the native SPOA-equivalent engine.
@@ -60,13 +61,13 @@ class TierDead(Exception):
 
 def tier_retries() -> int:
     """Extra attempts per tier before bisecting/demoting (default 1)."""
-    return max(0, int(os.environ.get("RACON_TPU_TIER_RETRIES", "1")))
+    return max(0, config.get_int("RACON_TPU_TIER_RETRIES"))
 
 
 def device_timeout() -> float:
     """Per-device-call watchdog in seconds; 0 (default) disables it."""
     try:
-        return float(os.environ.get("RACON_TPU_DEVICE_TIMEOUT", "0"))
+        return config.get_float("RACON_TPU_DEVICE_TIMEOUT")
     except ValueError:
         return 0.0
 

@@ -14,10 +14,11 @@ its total job/window count, clean or fault-injected.
 from __future__ import annotations
 
 import json
-import os
 import sys
 import time
 from typing import List, Optional, Tuple
+
+from .. import config
 
 ENV_REPORT = "RACON_TPU_REPORT"
 
@@ -109,19 +110,27 @@ class RunReport:
         return {
             "phases": {k: v.as_dict() for k, v in self.phases.items()},
             "fault_spec": active_spec(),
+            # stale-knob check: RACON_TPU_* vars set in the environment
+            # but unknown to the config registry — a typo'd knob surfaces
+            # here instead of being silently ignored
+            "unknown_knobs": config.unknown_env_knobs(),
             "wall_s": round(self.wall_s if self.wall_s is not None
                             else time.time() - self._t0, 3),
         }
 
     def summary(self) -> dict:
         """Compact serving-mix view for logs and the bench JSON line."""
-        return {
+        out = {
             phase: {"total": r.total, "served": dict(r.served),
                     "retries": r.retries, "bisections": r.bisections,
                     "quarantined": len(r.quarantined),
                     "degradations": len(r.degradations)}
             for phase, r in self.phases.items()
         }
+        stale = config.unknown_env_knobs()
+        if stale:
+            out["unknown_knobs"] = stale
+        return out
 
     def write(self, path: str) -> None:
         with open(path, "w") as f:
@@ -131,7 +140,7 @@ class RunReport:
     def write_env(self) -> None:
         """Write to $RACON_TPU_REPORT when set (bench/hw_session hook);
         a write failure warns, it never fails the polish."""
-        path = os.environ.get(ENV_REPORT)
+        path = config.get_raw(ENV_REPORT)
         if not path:
             return
         try:

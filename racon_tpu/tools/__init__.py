@@ -14,9 +14,9 @@ def force_cpu_if_requested() -> None:
     Measurement tools call this first so they can be pointed at the CPU
     backend while the tunnel is down.
     """
-    import os
+    from .. import config
 
-    if os.environ.get("RACON_TPU_FORCE_CPU") == "1":
+    if config.get_bool("RACON_TPU_FORCE_CPU"):
         import jax
 
         jax.config.update("jax_platforms", "cpu")

@@ -36,7 +36,6 @@ per-node microseconds are printed for each.
 Usage: python racon_tpu/tools/dp_cost_probe.py [R] [B] [reps]
 """
 
-import functools
 import os
 import sys
 import time
@@ -46,10 +45,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
 
 import numpy as np
 
+from racon_tpu.ops.kernel_cache import device_keyed_cache
+
 NEG = -(1 << 28)
 
 
-@functools.lru_cache(maxsize=16)
+@device_keyed_cache(maxsize=16)
 def build(mode: int, R: int, B: int, interpret: bool):
     import jax
     import jax.numpy as jnp

@@ -22,12 +22,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))))
 
 import racon_tpu
-from racon_tpu import native
+from racon_tpu import config, native
 from racon_tpu.tools import golden_scenarios as gs
 
 # same dataset location + override knob as tests/conftest.py (not imported:
 # this tool must not inherit the test suite's CPU-mesh forcing)
-DATA = os.environ.get("RACON_TPU_TEST_DATA", "/root/reference/test/data/")
+DATA = config.get_str("RACON_TPU_TEST_DATA")
 
 # The device pins isolate the CONSENSUS device path: phase 1 runs on the
 # host aligner unless the caller overrides. The existing paf=1282 pin was
@@ -88,8 +88,8 @@ def main():
         # a CPU/interpret-mode number must never be mistaken for the
         # hardware golden (the axon tunnel silently falls back when down)
         sys.exit(f"refusing to measure: platform is {platform!r}, not tpu")
-    tier = os.environ.get("RACON_TPU_POA_KERNEL", "ls")
-    aligner = os.environ.get("RACON_TPU_DEVICE_ALIGNER")
+    tier = config.get_str("RACON_TPU_POA_KERNEL")
+    aligner = config.get_raw("RACON_TPU_DEVICE_ALIGNER")
     print(f"platform={platform} kernel_tier={tier} aligner={aligner}")
 
     names = known if scenario == "all" else [scenario]

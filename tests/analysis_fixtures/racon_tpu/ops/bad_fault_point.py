@@ -1,0 +1,8 @@
+"""Fixture: fault-point — a check() name missing from KNOWN_POINTS."""
+
+from racon_tpu.resilience import faults
+
+
+def run(chunk):
+    faults.check("poa.run.no_such_tier", chunk)
+    return chunk

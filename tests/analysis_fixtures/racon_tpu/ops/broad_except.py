@@ -1,0 +1,15 @@
+"""Fixture: device-except — bare and undocumented broad catches."""
+
+
+def serve(kernel, batch):
+    try:
+        return kernel(batch)
+    except:  # bare: swallows the lattice's failure signal
+        return None
+
+
+def serve_broad(kernel, batch):
+    try:
+        return kernel(batch)
+    except Exception:
+        return None

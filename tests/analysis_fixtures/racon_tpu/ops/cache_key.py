@@ -1,0 +1,13 @@
+"""Fixture: kernel-cache-key — lru_cache'd builder with no topology key."""
+
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=8)
+def build_fixture_kernel(cap: int):
+    def fn(x):
+        return x * 2
+
+    return jax.jit(fn)

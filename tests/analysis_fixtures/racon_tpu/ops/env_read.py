@@ -1,0 +1,5 @@
+"""Fixture: env-registry — raw RACON_TPU_* env read outside config.py."""
+
+import os
+
+BATCH = int(os.environ.get("RACON_TPU_FIXTURE_BATCH", "8"))

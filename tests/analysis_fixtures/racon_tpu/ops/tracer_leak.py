@@ -1,0 +1,15 @@
+"""Fixture: tracer-leak — every flavor the rule knows, in one traced fn."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def leaky(x, threshold):
+    v = float(x[0])                   # concretizes the tracer
+    arr = np.asarray(x)               # host materialization
+    s = jnp.sum(x).item()             # device sync
+    if x[0] > threshold:              # data-dependent Python branch
+        return arr[0] + v + s
+    return x

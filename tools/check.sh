@@ -1,0 +1,64 @@
+#!/bin/sh
+# Repo check runner: first-party static analysis + generic lint + types +
+# native hygiene.  Degrades gracefully: third-party tools that are not
+# installed are reported and skipped (the container bakes a fixed
+# toolchain; nothing is pip-installed on the fly), so the exit code
+# reflects only checks that actually ran.
+#
+# Usage: tools/check.sh [--fast]
+#   --fast   skip the jaxpr audit and the native -Werror gate
+set -u
+
+root=$(cd "$(dirname "$0")/.." && pwd)
+cd "$root" || exit 1
+fast=${1:-}
+
+fail=0
+run() {  # run <name> <cmd...>
+    name=$1; shift
+    echo "== $name"
+    if "$@"; then
+        echo "   ok"
+    else
+        echo "   FAIL: $name"
+        fail=1
+    fi
+}
+
+skip() {
+    echo "== $1"
+    echo "   skipped: $2"
+}
+
+# 1. First-party analyzer: repo-specific TPU invariants + jaxpr audit.
+if [ "$fast" = "--fast" ]; then
+    run "racon_tpu.analysis (lint only)" \
+        env JAX_PLATFORMS=cpu python -m racon_tpu.analysis --no-jaxpr
+else
+    run "racon_tpu.analysis" \
+        env JAX_PLATFORMS=cpu python -m racon_tpu.analysis
+fi
+
+# 2. ruff (style + pyflakes), configured in pyproject.toml.
+if command -v ruff >/dev/null 2>&1; then
+    run "ruff" ruff check .
+else
+    skip "ruff" "not installed"
+fi
+
+# 3. mypy (type drift in the pure-Python drivers).
+if command -v mypy >/dev/null 2>&1; then
+    run "mypy" mypy
+else
+    skip "mypy" "not installed"
+fi
+
+# 4. Native hygiene: -Wall -Wextra -Werror syntax gate (+clang-tidy when
+#    available; the Makefile handles that probe itself).
+if [ "$fast" = "--fast" ]; then
+    skip "native lint" "--fast"
+else
+    run "native lint" make -C racon_tpu/native lint
+fi
+
+exit $fail
