@@ -297,6 +297,43 @@ def log_device_measurement(entry: dict) -> None:
               f"{e}", file=sys.stderr)
 
 
+def normalize_entry(e: dict) -> dict:
+    """Reader-side honesty backfill for bench JSON entries/log lines.
+
+    Older writers conflated "no device measurement" with "measured
+    zero": a dead tunnel emitted ``vs_baseline: 0.0`` next to a
+    ``[TPU UNREACHABLE ...]`` metric tag.  Current writers emit
+    ``vs_baseline: null`` plus ``device_status: "unreachable"``; this
+    helper lifts old entries to the same semantics so both generations
+    parse identically downstream.  A measured 0.0 (device reachable,
+    ratio genuinely zero) is left untouched."""
+    if not isinstance(e, dict):
+        return e
+    unreachable = (e.get("device_status") == "unreachable"
+                   or "TPU UNREACHABLE" in str(e.get("metric", "")))
+    if unreachable:
+        e = dict(e, device_status="unreachable")
+        if e.get("vs_baseline") == 0.0:
+            e["vs_baseline"] = None
+    return e
+
+
+def degraded_result(mbps_cpu: float, note: str = "") -> dict:
+    """Bench JSON for a dead-tunnel run.  `vs_baseline` is null — there
+    is NO device measurement — which is a different claim from a
+    measured ratio of 0.0; `device_status` carries the machine-readable
+    marker so readers don't have to parse the metric string."""
+    return {
+        "metric": f"polished Mbp/sec ({_WORKLOAD} {MBP} Mbp "
+                  f"{COVERAGE}x, {INPUT.upper()}, w=500, end-to-end) "
+                  f"[TPU UNREACHABLE: host path only{note}]",
+        "value": round(mbps_cpu, 4),
+        "unit": "Mbp/s",
+        "vs_baseline": None,
+        "device_status": "unreachable",
+    }
+
+
 def last_device_measurement():
     """Latest REAL device THROUGHPUT entry — forced dry-run entries and
     accuracy-only entries (golden re-pins, which carry no "value") never
@@ -313,7 +350,7 @@ def last_device_measurement():
                 except ValueError:
                     continue
                 if not e.get("forced") and "value" in e:
-                    entries.append(e)
+                    entries.append(normalize_entry(e))
     except OSError:
         return None
     return entries[-1] if entries else None
@@ -354,7 +391,10 @@ def main():
     if degraded:
         # Dead tunnel: emulating the device path on the CPU backend is
         # unboundedly slow and measures nothing real, so report the host
-        # path only, flagged, with vs_baseline 0 (= no device measurement).
+        # path only, flagged, with vs_baseline null — NO measurement,
+        # deliberately distinct from a measured 0.0 (see
+        # normalize_entry, which lifts old 0.0-style logs to the same
+        # semantics on the reader side).
         # Real on-device numbers from earlier healthy runs live in the
         # committed log; cite the latest so the evidence isn't erased.
         print("[bench] WARNING: TPU device unreachable; reporting host-path "
@@ -371,14 +411,7 @@ def main():
                     f"{prev.get('mbp', '?')} Mbp")
         bp_cpu, dt_cpu, _ = run("cpu", paths)
         mbps_cpu = bp_cpu / dt_cpu / 1e6
-        print(json.dumps({
-            "metric": f"polished Mbp/sec ({_WORKLOAD} {MBP} Mbp "
-                      f"{COVERAGE}x, {INPUT.upper()}, w=500, end-to-end) "
-                      f"[TPU UNREACHABLE: host path only{note}]",
-            "value": round(mbps_cpu, 4),
-            "unit": "Mbp/s",
-            "vs_baseline": 0.0,
-        }))
+        print(json.dumps(degraded_result(mbps_cpu, note)))
         print(f"[bench] cpu: {bp_cpu} bp in {dt_cpu:.1f}s", file=sys.stderr)
         return
 

@@ -31,6 +31,11 @@ def main(argv=None) -> int:
     p.add_argument("--write-baseline", action="store_true",
                    help="accept every current violation into the "
                         "baseline file and exit 0")
+    p.add_argument("--paths", nargs="+", default=None, metavar="REL",
+                   help="lint only these repo-relative files instead of "
+                        "the whole tree (CI uses this to focus on the "
+                        "modules a change touched); jaxpr audit is "
+                        "skipped when --paths is given")
     p.add_argument("--no-jaxpr", action="store_true",
                    help="skip the jaxpr audit (AST lint only; fast)")
     p.add_argument("--no-lint", action="store_true",
@@ -61,8 +66,8 @@ def main(argv=None) -> int:
     root = args.repo_root or lint.repo_root_for()
     violations: List[lint.Violation] = []
     if not args.no_lint:
-        violations.extend(lint.run_lint(root))
-    if not args.no_jaxpr:
+        violations.extend(lint.run_lint(root, paths=args.paths))
+    if not args.no_jaxpr and args.paths is None:
         violations.extend(jaxpr_audit.run_audit())
 
     baseline_path = args.baseline or os.path.join(

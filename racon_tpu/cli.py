@@ -59,6 +59,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="write a machine-readable JSON run report (per-phase "
                    "serving tiers, fallback causes, retries, quarantined "
                    "windows, wall time per tier) to PATH")
+    jr = p.add_mutually_exclusive_group()
+    jr.add_argument("--journal", metavar="PATH", default=None,
+                    help="append every served window/CIGAR to a crash-safe "
+                    "journal at PATH (fsynced JSONL; overwrites an existing "
+                    "file) so an interrupted run can be resumed")
+    jr.add_argument("--resume-journal", metavar="PATH", default=None,
+                    help="resume from the journal at PATH: replay every "
+                    "already-served window, recompute only the rest, and "
+                    "keep appending; output is byte-identical to an "
+                    "uninterrupted run (errors out if the journal belongs "
+                    "to different inputs/parameters; starts fresh if PATH "
+                    "does not exist)")
     p.add_argument("--version", action="version", version=__version__)
     return p
 
@@ -68,6 +80,7 @@ def main(argv=None) -> int:
 
     from .native import NativeError
     from .resilience import faults
+    from .resilience.journal import JournalError
 
     # Validate the fault-injection spec up front (same contract as the
     # file-extension checks: single-line error, exit 1) — a malformed
@@ -108,12 +121,19 @@ def main(argv=None) -> int:
             error_threshold=args.error_threshold,
             trim=not args.no_trimming,
             match=args.match, mismatch=args.mismatch, gap=args.gap,
-            num_threads=args.threads)
+            num_threads=args.threads,
+            journal_path=args.resume_journal or args.journal,
+            resume_journal=args.resume_journal is not None)
         polisher.initialize()
         for name, data in polisher.polish(not args.include_unpolished):
             sys.stdout.write(f">{name}\n{data}\n")
         if args.report:
             polisher.report.write(args.report)
+    except JournalError as e:
+        # same single-line contract as a malformed fault spec: resuming
+        # against the wrong inputs must fail loudly before any compute
+        print(e, file=sys.stderr)
+        return 1
     except NativeError as e:
         # the reference binary surfaces runtime errors as the what() text
         # and a non-zero exit (src/main.cpp catches nothing); a Python

@@ -79,6 +79,15 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
        "deterministic fault injection spec (see resilience/faults.py)"),
     _k("RACON_TPU_REPORT", None, "str",
        "write the JSON run report to this path after every polish"),
+    _k("RACON_TPU_WEDGE_LIMIT", "3", "int",
+       "consecutive watchdog timeouts before a tier is declared wedged "
+       "and demoted without retry (0 = off)"),
+    _k("RACON_TPU_JOURNAL", None, "str",
+       "crash-safe window journal path; auto-resumes when the input "
+       "fingerprint matches (fresh otherwise)"),
+    _k("RACON_TPU_JOURNAL_FSYNC", "1", "bool",
+       "fsync the journal after every record (0 trades durability for "
+       "speed: a crash may lose buffered records)"),
     # -- test / bench knobs ----------------------------------------------
     _k("RACON_TPU_HW_TESTS", None, "bool",
        "assert exact on-hardware pins against a real TPU backend",

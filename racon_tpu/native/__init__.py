@@ -111,6 +111,9 @@ def load() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_char_p), u32p,
         ctypes.POINTER(ctypes.c_char_p), u32p]
 
+    lib.rt_pipeline_align_job_lengths.restype = None
+    lib.rt_pipeline_align_job_lengths.argtypes = [ctypes.c_void_p, u32p]
+
     lib.rt_pipeline_set_job_cigar.restype = None
     lib.rt_pipeline_set_job_cigar.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p]

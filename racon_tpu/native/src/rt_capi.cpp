@@ -156,6 +156,21 @@ void rt_pipeline_align_job(void* handle, uint64_t job, const char** q,
   });
 }
 
+// Bulk (q_len, t_len) export: out[2k] = q_len, out[2k+1] = t_len for every
+// alignment job k.  One ABI crossing instead of num_align_jobs() of them —
+// the Python driver re-reads the length table at each device-engine attempt.
+void rt_pipeline_align_job_lengths(void* handle, uint32_t* out) {
+  guarded_void([&] {
+    auto* p = static_cast<PipelineHandle*>(handle)->pipeline.get();
+    const uint64_t n = p->num_align_jobs();
+    const char* q = nullptr;
+    const char* t = nullptr;
+    for (uint64_t k = 0; k < n; ++k) {
+      p->align_job_views(k, &q, &out[2 * k], &t, &out[2 * k + 1]);
+    }
+  });
+}
+
 void rt_pipeline_set_job_cigar(void* handle, uint64_t job, const char* cigar) {
   guarded_void([&] {
     static_cast<PipelineHandle*>(handle)->pipeline->set_job_cigar(job, cigar);

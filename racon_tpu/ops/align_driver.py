@@ -46,24 +46,34 @@ def _engine() -> str:
     return "host"
 
 
-def run_alignment_phase(pipeline, progress: bool = False) -> dict:
+def run_alignment_phase(pipeline, progress: bool = False,
+                        journal=None) -> dict:
     """Device alignment for every eligible CIGAR-less overlap; host for
     the rest.  Device failures run through the degradation lattice inside
     the engines' run_jobs (per-cohort retry, bisection-quarantine, engine
     death -> host for the remainder); already-installed CIGARs are kept
     and the served count survives a mid-phase engine failure.
 
+    With `journal` armed, device-served CIGARs journaled by a previous
+    run are replayed (and excluded from device batching — the native
+    host pass skips any job whose CIGAR is already set), and fresh
+    device results are journaled through a CigarTap as the engines
+    install them.  Host-computed CIGARs are not journaled: the native
+    engine recomputes them deterministically on resume.
+
     Returns stats {device:…, host:…, report: PhaseReport} — the report's
     per-tier served counts sum to the job count, clean or
     fault-injected."""
     from ..resilience import faults
     from ..resilience import lattice as rl
+    from ..resilience.journal import CigarTap, replay_cigars
     from ..resilience.report import PhaseReport
 
-    report = PhaseReport("alignment", rl.ALIGN_TIERS)
+    report = PhaseReport("alignment", rl.ALIGN_TIERS + ("journal",))
     stats = {"device": 0, "host": 0, "report": report}
     n = pipeline.num_align_jobs()
     report.total = n
+    replayed = replay_cigars(pipeline, journal, n, report)
     if n:
         # engine resolution inside the guard AND the try: with no align
         # jobs (SAM input) phase 1 must not touch the JAX backend at all,
@@ -79,23 +89,27 @@ def run_alignment_phase(pipeline, progress: bool = False) -> dict:
                 from . import align_pallas
 
                 lengths = pipeline.align_job_lengths()
-                jobs = [i for i in range(n)
-                        if align_pallas.band_for(int(lengths[i, 0]),
-                                                 int(lengths[i, 1])) > 0]
+                jobs = [i for i in range(n) if i not in replayed
+                        and align_pallas.band_for(int(lengths[i, 0]),
+                                                  int(lengths[i, 1])) > 0]
                 if jobs:
+                    sink = (CigarTap(pipeline, journal, "hirschberg")
+                            if journal is not None else pipeline)
                     stats["device"] = align_pallas.run_jobs(
-                        pipeline, jobs, report=report)
+                        sink, jobs, report=report)
             else:
                 faults.check("align.compile")
                 from . import align
 
                 lengths = pipeline.align_job_lengths()
-                jobs = [i for i in range(n)
-                        if align.device_eligible(lengths[i, 0],
-                                                 lengths[i, 1])]
+                jobs = [i for i in range(n) if i not in replayed
+                        and align.device_eligible(lengths[i, 0],
+                                                  lengths[i, 1])]
                 if jobs:
+                    sink = (CigarTap(pipeline, journal, "xla")
+                            if journal is not None else pipeline)
                     stats["device"] = align.run_jobs(
-                        pipeline, jobs, report=report)
+                        sink, jobs, report=report)
         except Exception as e:  # noqa: BLE001 — engine/backend init
             print(f"[racon_tpu::align] WARNING: device aligner "
                   f"'{engine}' failed ({type(e).__name__}: {e}); "
@@ -108,6 +122,6 @@ def run_alignment_phase(pipeline, progress: bool = False) -> dict:
     t0 = time.perf_counter()
     pipeline.align_jobs_cpu()
     report.add_wall("host", time.perf_counter() - t0)
-    stats["host"] = n - stats["device"]
+    stats["host"] = n - stats["device"] - len(replayed)
     report.record_served("host", stats["host"])
     return stats

@@ -30,6 +30,7 @@ import numpy as np
 from .. import config
 from ..resilience import faults
 from ..resilience import lattice as rl
+from ..resilience.journal import replay_windows
 from ..resilience.report import PhaseReport
 from . import poa
 from .encoding import decode, encode
@@ -144,7 +145,8 @@ def tgs_trim(codes: np.ndarray, cov: np.ndarray, n_seqs: int):
 
 
 def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
-                        trim: bool, progress: bool = False) -> dict:
+                        trim: bool, progress: bool = False,
+                        journal=None) -> dict:
     """Device consensus for every eligible window; host for the rest.
 
     Streaming: a cheap metadata pass (window_info — no bases copied) sizes
@@ -158,19 +160,30 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
     Returns stats {device:…, host_fallback:…, backbone:…, failed:…,
     layers_dropped:…, report: PhaseReport} — the report's per-tier served
     counts sum to the window count, clean or fault-injected.
+
+    With `journal` (resilience/journal.py) armed, windows already in the
+    journal are replayed up front (served tier "journal") and every
+    freshly served window — device, host fallback, or backbone — is
+    appended as it is installed, so a crash loses at most the in-flight
+    batch.
     """
     n = pipeline.num_windows()
-    report = PhaseReport("consensus", rl.CONSENSUS_TIERS + ("backbone",))
+    report = PhaseReport("consensus",
+                         rl.CONSENSUS_TIERS + ("backbone", "journal"))
     report.total = n
     stats = {"device": 0, "host_fallback": 0, "backbone": 0, "failed": 0,
              "layers_dropped": 0, "report": report}
+
+    replayed = replay_windows(pipeline, journal, n, report)
 
     fallback: List[int] = []
 
     # Metadata pass: geometry + depth buckets, no layer bytes touched.
     jobs = []          # (window_idx, estimated depth, backbone len)
     for i in range(n):
-        n_seqs, bb_len, _rank, _is_tgs, _bytes, _tid = pipeline.window_info(i)
+        if i in replayed:
+            continue
+        n_seqs, bb_len, _rank, _is_tgs, _bytes, tid = pipeline.window_info(i)
         k = n_seqs - 1
         if k < 2:
             # <3 sequences incl. backbone: backbone passthrough
@@ -182,6 +195,9 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
                 report.record_quarantine(i, e)
                 continue
             pipeline.set_consensus(i, wx.backbone.tobytes(), False)
+            if journal is not None:
+                journal.append_window(i, tid, wx.rank, "backbone",
+                                      wx.backbone.tobytes(), False)
             stats["backbone"] += 1
             continue
         jobs.append((i, min(k, DEPTH_CAP), bb_len))
@@ -261,23 +277,28 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
                     report.record_failure(kind, e)
                     report.retries += 1
                     _resolve(pipeline, chunk, None, cfg, B, kind,
-                             dead_geoms, trim, stats, fallback, report)
+                             dead_geoms, trim, stats, fallback, report,
+                             journal)
                     continue
                 pending.append((chunk, packed, outs, cfg, kind))
                 if len(pending) >= q_depth:
                     _drain(pipeline, pending.popleft(), trim, stats,
-                           fallback, B, dead_geoms, report)
+                           fallback, B, dead_geoms, report, journal)
             if progress:
                 print(f"[racon_tpu::poa] bucket depth<={depth_bucket} "
                       f"len<={wl_class}: {len(bucket_jobs)} windows",
                       file=sys.stderr)
         while pending:
             _drain(pipeline, pending.popleft(), trim, stats, fallback, B,
-                   dead_geoms, report)
+                   dead_geoms, report, journal)
 
     t0 = time.perf_counter()
     for i in fallback:
-        pipeline.consensus_cpu_one(i)
+        polished = pipeline.consensus_cpu_one(i)
+        if journal is not None:
+            _, _, rank, _, _, tid = pipeline.window_info(i)
+            journal.append_window(i, tid, rank, "host",
+                                  pipeline.get_consensus(i), polished)
         stats["host_fallback"] += 1
     report.add_wall("host", time.perf_counter() - t0)
     report.record_served("host", stats["host_fallback"])
@@ -392,7 +413,7 @@ def _warn_degrade(e, to_kind: str) -> None:
 
 
 def _resolve(pipeline, chunk, outs, cfg, B, kind, dead_geoms, trim, stats,
-             fallback, report):
+             fallback, report, journal=None):
     """Fully serve one exported chunk through the lattice, starting at
     `kind` with optionally already-dispatched device futures `outs`.
 
@@ -433,7 +454,7 @@ def _resolve(pipeline, chunk, outs, cfg, B, kind, dead_geoms, trim, stats,
             continue
         for sub, results in pairs:
             _install(pipeline, sub, results, trim, stats, fallback,
-                     report, kind)
+                     report, kind, journal)
         for item, exc in quarantined:
             fallback.append(item[0])
             report.record_quarantine(item[0], exc)
@@ -441,7 +462,7 @@ def _resolve(pipeline, chunk, outs, cfg, B, kind, dead_geoms, trim, stats,
 
 
 def _drain(pipeline, pending, trim, stats, fallback, B, dead_geoms,
-           report):
+           report, journal=None):
     """Block on an in-flight chunk's device results and install them.
 
     If the kernel failed at runtime (error surfaces at the blocking
@@ -449,7 +470,7 @@ def _drain(pipeline, pending, trim, stats, fallback, B, dead_geoms,
     demote — with the packed arrays still on hand."""
     chunk, packed, outs, cfg, kind = pending
     _resolve(pipeline, chunk, outs, cfg, B, kind, dead_geoms, trim, stats,
-             fallback, report)
+             fallback, report, journal)
 
 
 def _use_pallas() -> bool:
@@ -655,7 +676,7 @@ def _unpack(outs, use_pallas):
 
 
 def _install(pipeline, chunk, results, trim, stats, fallback, report=None,
-             tier=None):
+             tier=None, journal=None):
     cons_base, cons_cov, cons_len, failed = results
     for bi, (i, wx, keep) in enumerate(chunk):
         if failed[bi]:
@@ -682,7 +703,11 @@ def _install(pipeline, chunk, results, trim, stats, fallback, report=None,
             kept_codes = tgs_trim(out, np.asarray(cov), n_admitted_seqs)
         else:
             kept_codes = out
-        pipeline.set_consensus(i, decode(kept_codes), True)
+        payload = decode(kept_codes)
+        pipeline.set_consensus(i, payload, True)
+        if journal is not None:
+            journal.append_window(i, wx.target_id, wx.rank,
+                                  tier or "device", payload, True)
         stats["device"] += 1
         if report is not None and tier is not None:
             report.record_served(tier)

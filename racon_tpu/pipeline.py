@@ -81,7 +81,20 @@ class Pipeline:
         return qa, ta
 
     def align_job_lengths(self) -> np.ndarray:
-        """(q_len, t_len) per job without copying the bytes."""
+        """(q_len, t_len) per job without copying the bytes — one bulk
+        ABI crossing (the per-job loop survives as `_align_job_lengths_loop`,
+        the parity oracle)."""
+        n = self.num_align_jobs()
+        out = np.zeros((n, 2), dtype=np.uint32)
+        if n:
+            self._lib.rt_pipeline_align_job_lengths(
+                self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+            native.check_error(self._lib)
+        return out
+
+    def _align_job_lengths_loop(self) -> np.ndarray:
+        """Per-job ctypes loop — the pre-bulk implementation, kept as the
+        differential-test oracle for rt_pipeline_align_job_lengths."""
         n = self.num_align_jobs()
         out = np.zeros((n, 2), dtype=np.uint32)
         q = ctypes.c_char_p()

@@ -6,23 +6,56 @@ the base Polisher or the CUDA subclass, /root/reference/src/polisher.cpp:
 phases run either on the host oracle or on the TPU batch kernels with host
 fallback for rejected work (the reference's graceful-degradation lattice,
 src/cuda/cudapolisher.cpp:204-213,354-378).
+
+Preemption tolerance: pass `journal_path` (CLI `--journal` /
+`--resume-journal`, or the `RACON_TPU_JOURNAL` knob) and every served
+window/CIGAR is appended to a crash-safe journal
+(resilience/journal.py) as it is installed; a resumed run replays the
+journal, recomputes only what is missing, and produces byte-identical
+output.
 """
 
 from __future__ import annotations
 
-from typing import List, Tuple
+import time
+from typing import List, Optional, Tuple
 
+from . import config
 from .pipeline import Pipeline
-from .resilience import faults
-from .resilience.report import RunReport
+from .resilience import faults, watchdog
+from .resilience.journal import (Journal, input_fingerprint,
+                                 replay_windows)
+from .resilience.report import PhaseReport, RunReport
+
+
+def _open_journal(paths: Tuple[str, str, str], backend: str,
+                  journal_path: Optional[str], resume: bool,
+                  params: dict) -> Optional[Journal]:
+    """Resolve this run's journal.  An explicit path (the CLI flags) wins
+    and a fingerprint mismatch on explicit resume is an error; the
+    `RACON_TPU_JOURNAL` knob auto-resumes and falls back to a fresh
+    journal when the fingerprint says the inputs changed."""
+    on_mismatch = "error"
+    if journal_path is None:
+        journal_path = config.get_str("RACON_TPU_JOURNAL") or None
+        resume, on_mismatch = True, "fresh"
+    if journal_path is None:
+        return None
+    fp = input_fingerprint(paths, params, backend)
+    return Journal(journal_path, fp, resume=resume, on_mismatch=on_mismatch)
 
 
 class CpuPolisher:
     """Pure-host polishing (the correctness oracle)."""
 
     def __init__(self, sequences_path: str, overlaps_path: str,
-                 target_path: str, **kwargs):
-        faults.reset()  # per-run firing schedule (deterministic)
+                 target_path: str, journal_path: Optional[str] = None,
+                 resume_journal: bool = False, **kwargs):
+        faults.reset()     # per-run firing schedule (deterministic)
+        watchdog.reset()   # per-run wedge streaks
+        self._journal = _open_journal(
+            (sequences_path, overlaps_path, target_path), "cpu",
+            journal_path, resume_journal, kwargs)
         self._pipeline = Pipeline(sequences_path, overlaps_path, target_path,
                                   **kwargs)
         self.report = RunReport()
@@ -31,10 +64,37 @@ class CpuPolisher:
         self._pipeline.initialize()
 
     def polish(self, drop_unpolished: bool = True) -> List[Tuple[str, str]]:
-        self._pipeline.consensus_cpu_all()
+        if self._journal is None:
+            self._pipeline.consensus_cpu_all()
+        else:
+            self._polish_journaled(self._journal)
         out = self._pipeline.stitch(drop_unpolished)
+        if self._journal is not None:
+            self._journal.close()
         self.report.finalize().write_env()
         return out
+
+    def _polish_journaled(self, jr: Journal) -> None:
+        # Window-at-a-time host consensus so every result is durable the
+        # moment it exists (consensus_cpu_all's thread pool computes the
+        # whole run before Python sees anything to journal); sequential
+        # serving is the durability price on the host path.
+        pipeline = self._pipeline
+        n = pipeline.num_windows()
+        rep = PhaseReport("consensus", ("journal", "host"))
+        rep.total = n
+        replayed = replay_windows(pipeline, jr, n, rep)
+        t0 = time.perf_counter()
+        for i in range(n):
+            if i in replayed:
+                continue
+            polished = pipeline.consensus_cpu_one(i)
+            _, _, rank, _, _, tid = pipeline.window_info(i)
+            jr.append_window(i, tid, rank, "host",
+                             pipeline.get_consensus(i), polished)
+            rep.record_served("host")
+        rep.add_wall("host", time.perf_counter() - t0)
+        self.report.attach(rep)
 
 
 class TpuPolisher:
@@ -44,12 +104,18 @@ class TpuPolisher:
     After polish(), `self.report` (a resilience.report.RunReport) holds
     the per-phase serving/fallback accounting — who served what, why
     anything fell back, retries/bisections, quarantined windows, wall
-    time per tier."""
+    time per tier, and (on a resumed run) how many units the journal
+    replayed vs how many were served fresh."""
 
     def __init__(self, sequences_path: str, overlaps_path: str,
-                 target_path: str, **kwargs):
-        faults.reset()  # per-run firing schedule (deterministic)
+                 target_path: str, journal_path: Optional[str] = None,
+                 resume_journal: bool = False, **kwargs):
+        faults.reset()     # per-run firing schedule (deterministic)
+        watchdog.reset()   # per-run wedge streaks
         self._kwargs = dict(kwargs)
+        self._journal = _open_journal(
+            (sequences_path, overlaps_path, target_path), "tpu",
+            journal_path, resume_journal, kwargs)
         self._pipeline = Pipeline(sequences_path, overlaps_path, target_path,
                                   **kwargs)
         self.report = RunReport()
@@ -63,7 +129,8 @@ class TpuPolisher:
                 "run without --tpu for the host path") from e
 
         self._pipeline.prepare()
-        stats = run_alignment_phase(self._pipeline)  # device + host fallback
+        stats = run_alignment_phase(self._pipeline,
+                                    journal=self._journal)
         self.report.attach(stats.get("report"))
         self._pipeline.build_windows()
 
@@ -74,16 +141,21 @@ class TpuPolisher:
                                     match=self._kwargs.get("match", 3),
                                     mismatch=self._kwargs.get("mismatch", -5),
                                     gap=self._kwargs.get("gap", -4),
-                                    trim=self._kwargs.get("trim", True))
+                                    trim=self._kwargs.get("trim", True),
+                                    journal=self._journal)
         self.report.attach(stats.get("report"))
         out = self._pipeline.stitch(drop_unpolished)
+        if self._journal is not None:
+            self._journal.close()
         self.report.finalize().write_env()
         return out
 
 
 def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
                     backend: str = "cpu", **kwargs):
-    """Factory. backend: 'cpu' (host oracle) or 'tpu' (device batched)."""
+    """Factory. backend: 'cpu' (host oracle) or 'tpu' (device batched).
+    `journal_path=`/`resume_journal=` arm the crash-safe result journal
+    (see resilience/journal.py)."""
     if backend == "cpu":
         return CpuPolisher(sequences_path, overlaps_path, target_path,
                            **kwargs)

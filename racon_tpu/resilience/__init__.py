@@ -12,23 +12,33 @@ that posture a tested subsystem instead of scattered try/except blocks:
 * `lattice` — the ordered degradation tiers (ls -> v2 -> xla -> host for
   consensus; hirschberg/xla -> host for alignment) plus the shared
   retry / watchdog / batch-bisection machinery the drivers run through.
+* `watchdog`— the deadline-scoped timer around device dispatch and the
+  wedge tracker that classifies repeated timeouts as a wedged tier
+  (`TierWedged`) so a hung jit call demotes instead of hanging the run.
+* `journal` — the crash-safe, append-only window-result journal behind
+  `--journal` / `--resume-journal` / `RACON_TPU_JOURNAL`: a SIGKILLed
+  run resumes and reproduces byte-identical output.
 * `report`  — per-phase serving/fallback accounting surfaced through
   `Polisher.polish()`, the `--report` CLI flag, `RACON_TPU_REPORT`, and
   `bench.py` / `tools/hw_session.py`.
 """
 
-from . import faults, lattice, report  # noqa: F401
+from . import faults, journal, lattice, report, watchdog  # noqa: F401
 from .faults import InjectedFault, MosaicError, check, parse_spec, reset
-from .lattice import (ALIGN_TIERS, CONSENSUS_TIERS, TierDead,
+from .journal import CigarTap, Journal, JournalError, input_fingerprint
+from .lattice import (ALIGN_TIERS, CONSENSUS_TIERS, TierDead, TierWedged,
                       WatchdogTimeout, call_with_watchdog, device_timeout,
                       serve_with_bisect, tier_retries)
 from .report import PhaseReport, RunReport
+from .watchdog import WedgeTracker, wedge_limit
 
 __all__ = [
-    "faults", "lattice", "report",
+    "faults", "journal", "lattice", "report", "watchdog",
     "InjectedFault", "MosaicError", "check", "parse_spec", "reset",
-    "ALIGN_TIERS", "CONSENSUS_TIERS", "TierDead", "WatchdogTimeout",
-    "call_with_watchdog", "device_timeout", "serve_with_bisect",
-    "tier_retries",
+    "CigarTap", "Journal", "JournalError", "input_fingerprint",
+    "ALIGN_TIERS", "CONSENSUS_TIERS", "TierDead", "TierWedged",
+    "WatchdogTimeout", "call_with_watchdog", "device_timeout",
+    "serve_with_bisect", "tier_retries",
     "PhaseReport", "RunReport",
+    "WedgeTracker", "wedge_limit",
 ]

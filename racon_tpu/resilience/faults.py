@@ -12,6 +12,7 @@ them:
 Spec grammar (comma-separated specs; colon-separated fields):
 
     <point>[:batch=N][:window=I][:count=N][:hang=SECONDS][:raise=NAME]
+           [:kill=1]
 
 * `point`   — one of KNOWN_POINTS below.  The first field.
 * `batch=N` — fire only on the Nth invocation of the point (0-based,
@@ -28,6 +29,11 @@ Spec grammar (comma-separated specs; colon-separated fields):
   per-device-call watchdog; combine with `RACON_TPU_DEVICE_TIMEOUT`).
 * `raise=NAME` — exception class to raise (default `MosaicError`, the
   synthetic stand-in for a Mosaic compile/runtime failure).
+* `kill=1`  — SIGKILL the whole process instead of raising: the
+  deterministic mid-run crash (no handlers, no flushing — exactly what
+  a preemption does).  Combine with `batch=N` on `journal.append` to
+  die after exactly N journaled results; the crash-resume tests are
+  built on it.
 
 Specs are validated eagerly: a malformed spec raises `ValueError` with a
 single-line message (the CLI surfaces it as exit 1, reference-style).
@@ -37,6 +43,8 @@ so consecutive runs in one process see identical firing schedules.
 
 from __future__ import annotations
 
+import os
+import signal
 import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
@@ -60,6 +68,9 @@ KNOWN_POINTS = frozenset({
     "poa.run.xla",       # XLA-twin consensus, per submitted batch
     "native.call",       # host (native) engine calls — the lattice floor
     "window.export",     # per-window export from the native pipeline
+    "journal.append",    # durable-journal record write (resilience/journal)
+    "journal.replay",    # journal replay on --resume-journal
+    "watchdog.call",     # device-dispatch entry under the watchdog
 })
 
 
@@ -92,6 +103,7 @@ class FaultSpec:
     window: Optional[int] = None
     count: int = _UNLIMITED
     hang: float = 0.0
+    kill: bool = False
     raise_name: str = "MosaicError"
     fired: int = field(default=0, compare=False)
 
@@ -133,6 +145,8 @@ def parse_spec(text: str) -> list:
                     spec.count = int(val)
                 elif key == "hang":
                     spec.hang = float(val)
+                elif key == "kill":
+                    spec.kill = int(val) != 0
                 elif key == "raise":
                     if val not in EXCEPTIONS:
                         raise ValueError(
@@ -142,7 +156,7 @@ def parse_spec(text: str) -> list:
                 else:
                     raise ValueError(f"{ENV}: unknown key {key!r} "
                                      f"(valid: batch, window, count, hang, "
-                                     f"raise)")
+                                     f"kill, raise)")
             except ValueError as e:
                 if str(e).startswith(ENV):
                     raise
@@ -172,6 +186,11 @@ class FaultPlan:
                 if windows is None or spec.window not in windows:
                     continue
             spec.fired += 1
+            if spec.kill:
+                # the deterministic preemption: no cleanup, no flush —
+                # the process is gone mid-append, exactly like a real
+                # SIGKILL/OOM/eviction
+                os.kill(os.getpid(), signal.SIGKILL)
             if spec.hang:
                 time.sleep(spec.hang)
                 return

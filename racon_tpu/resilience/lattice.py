@@ -22,6 +22,10 @@ Failure taxonomy the drivers map onto this module:
   (`RACON_TPU_TIER_RETRIES`, default 1 extra attempt)
 * hung device call         -> watchdog timeout surfaces it as an error
   (`RACON_TPU_DEVICE_TIMEOUT` seconds; 0/unset = disabled)
+* wedged tier              -> `RACON_TPU_WEDGE_LIMIT` consecutive
+  watchdog timeouts classify the tier as wedged (`TierWedged`, a
+  TierDead subtype): demote immediately instead of burning one full
+  deadline per retry (see resilience/watchdog.py)
 * window-correlated failure-> batch bisection: the failing batch is
   split, halves are probed, and the poisoned window is quarantined to
   the host while the rest of the batch stays on the device
@@ -31,11 +35,15 @@ Failure taxonomy the drivers map onto this module:
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from .. import config
+# the watchdog moved to its own module (resilience/watchdog.py); the
+# names stay importable from here — every caller and test uses the
+# lattice as the façade
+from .watchdog import (WatchdogTimeout, call_with_watchdog,  # noqa: F401
+                       device_timeout, tracker)
 
 #: Consensus kernel tiers, best first.  "host" is the floor: windows are
 #: re-polished one-by-one by the native SPOA-equivalent engine.
@@ -47,10 +55,6 @@ CONSENSUS_TIERS = ("ls", "v2", "xla", "host")
 ALIGN_TIERS = ("hirschberg", "xla", "host")
 
 
-class WatchdogTimeout(Exception):
-    """A device call exceeded the RACON_TPU_DEVICE_TIMEOUT watchdog."""
-
-
 class TierDead(Exception):
     """The current tier fails batch-independently; demote the geometry."""
 
@@ -59,45 +63,18 @@ class TierDead(Exception):
         self.cause = cause
 
 
+class TierWedged(TierDead):
+    """The tier kept timing out (RACON_TPU_WEDGE_LIMIT consecutive
+    watchdog expiries): a wedged jit call, the axon tunnel's signature
+    failure.  A TierDead subtype — callers demote exactly as for any
+    dead tier — but distinguishable in reports, and raised *instead of
+    retrying* so a wedged tier stops costing one full watchdog deadline
+    per attempt."""
+
+
 def tier_retries() -> int:
     """Extra attempts per tier before bisecting/demoting (default 1)."""
     return max(0, config.get_int("RACON_TPU_TIER_RETRIES"))
-
-
-def device_timeout() -> float:
-    """Per-device-call watchdog in seconds; 0 (default) disables it."""
-    try:
-        return config.get_float("RACON_TPU_DEVICE_TIMEOUT")
-    except ValueError:
-        return 0.0
-
-
-def call_with_watchdog(fn: Callable, timeout: Optional[float] = None):
-    """Run fn() under the watchdog.  With no timeout configured this is a
-    direct call (no thread).  On expiry raises WatchdogTimeout — the
-    abandoned call keeps its daemon thread (a truly hung device op cannot
-    be cancelled from Python; the caller's job is to stop feeding the
-    dead tier, which the lattice does by demoting it)."""
-    t = device_timeout() if timeout is None else timeout
-    if not t or t <= 0:
-        return fn()
-    box = {}
-
-    def runner():
-        try:
-            box["result"] = fn()
-        except BaseException as e:  # noqa: BLE001 — relayed to caller
-            box["error"] = e
-
-    th = threading.Thread(target=runner, daemon=True,
-                          name="racon-tpu-watchdog-call")
-    th.start()
-    th.join(t)
-    if th.is_alive():
-        raise WatchdogTimeout(f"device call exceeded the {t:.3g}s watchdog")
-    if "error" in box:
-        raise box["error"]
-    return box["result"]
 
 
 def serve_with_bisect(items: Sequence, attempt: Callable,
@@ -127,11 +104,16 @@ def serve_with_bisect(items: Sequence, attempt: Callable,
     ultimately the host, serves them).
     """
     n_retries = tier_retries() if retries is None else retries
+    if tracker().is_wedged(tier):
+        # the tier wedged earlier in this run — do not feed it at all
+        raise TierWedged(WatchdogTimeout(
+            f"tier {tier!r} is wedged ({tracker().streak(tier)} "
+            f"consecutive watchdog timeouts)", tier=tier))
 
     def timed(fn):
         t0 = time.perf_counter()
         try:
-            return call_with_watchdog(fn)
+            return call_with_watchdog(fn, tier=tier)
         finally:
             if report is not None:
                 report.add_wall(tier, time.perf_counter() - t0)
@@ -149,11 +131,19 @@ def serve_with_bisect(items: Sequence, attempt: Callable,
                     report.record_failure(tier, e)
                     if a < n_retries:
                         report.retries += 1
+                if (isinstance(e, WatchdogTimeout)
+                        and tracker().is_wedged(tier)):
+                    # repeated expiry = wedged jit call; each further
+                    # attempt would burn a full deadline, so classify
+                    # and demote instead of retrying/bisecting
+                    raise TierWedged(e) from e
         raise last
 
     def serve(sub, use_cached):
         try:
             return [(list(sub), attempts(sub, use_cached))], []
+        except TierDead:
+            raise               # wedge classification — not bisectable
         except Exception as e:  # noqa: BLE001 — lattice boundary
             if len(sub) <= 1:
                 return [], [(sub[0], e)]

@@ -1,60 +1,65 @@
-"""One-command TPU hardware session: run the full measurement priority
-list the moment the tunnel is healthy, every step in a bounded subprocess.
+"""One-command, self-healing TPU hardware session: run the full
+measurement priority list the moment the tunnel is healthy, every step
+in a bounded subprocess, and emit a partial-session report no matter
+how the tunnel behaves.
 
 The axon tunnel wedges for hours and can die mid-session (round 2: it
-wedged between the bench and the golden re-pin), so the priority order
-front-loads the headline evidence and every step is independently
-time-boxed and durably logged — a step that hangs is killed and the
-session moves on. Priorities:
+wedged between the bench and the golden re-pin; VERDICT.md counts five
+rounds lost to it), so the orchestrator assumes failure is the common
+case:
+
+* **step-level timeouts** — a step that hangs is killed (whole process
+  group) and the session moves on;
+* **exponential retry with backoff + jitter** — a step that *fails*
+  (non-zero exit: the tunnel flapping, a transient XLA init error) is
+  retried up to ``--retries`` times with ``--backoff * 2^k`` seconds
+  (+0-25% jitter) between attempts.  Timeouts are NOT retried: the
+  bound was already the generous estimate, and re-burning it on a
+  wedged tunnel would cost the rest of the session;
+* **per-step checkpoint files** — each completed step drops a JSON
+  checkpoint under ``--state-dir``; re-running the session (default)
+  skips checkpointed steps, so a crashed/killed session resumes where
+  it stopped.  ``--fresh`` clears the state first;
+* **no abort** — a failed probe no longer exits the session: later
+  steps are recorded as ``skipped`` (with the reason) and the session
+  still writes its report.  An unhealthy tunnel yields every step that
+  did complete plus an honest account of the ones that could not;
+* **partial-session report** — ``docs/hw_session_report.json`` lists
+  every step's outcome (ok / failed / timeout / cached / skipped),
+  attempts, and wall time; a summary line also lands in the durable
+  ``docs/hw_session_log.jsonl`` evidence trail.
+
+Priorities (unchanged):
 
   1. probe        — device reachable + tiny matmul (2 min bound)
-  2. bench        — python bench.py at the default 0.5 Mbp; bench.py
-                    itself probes pallas tiers, warms geometries, appends
-                    to docs/device_bench_log.jsonl, and re-pins the λ
-                    golden (45 min)
-  3. bench_sam    — SAM input (no alignment phase): isolates the
-                    consensus kernel, ls tier (45 min)
-  4. bench_sam_v2 — same with RACON_TPU_POA_KERNEL=v2: the on-chip
-                    ls-vs-v2 tier decision (45 min)
-  4b. bench_sam_xla64 — same through the vmapped XLA kernel at
-                    RACON_TPU_BATCH_WINDOWS=64: the cost model's
-                    bandwidth-bound alternative to both hand kernels
-                    (45 min)
-  4c. bench_sam_sr — consensus bench on the short-read profile
-                    (150 bp @ ~1% error, BASELINE config-4 regime:
-                    NGS windows, deep shallow layers) (45 min)
+  2. bench        — python bench.py at the default 0.5 Mbp (45 min)
+  3. bench_sam    — SAM input (no alignment phase): consensus ls tier
+  4. bench_sam_v2 — same with RACON_TPU_POA_KERNEL=v2
+  4b. bench_sam_xla64 — vmapped XLA kernel at RACON_TPU_BATCH_WINDOWS=64
+  4c. bench_sam_sr — short-read profile consensus bench
   5. bench5       — RACON_TPU_BENCH_MBP=5 scale run (90 min)
-  6. pin_<scenario> — one bounded pin_device_golden.py run per golden
-                    scenario (10 min each; 'pins' expands to all ten —
-                    a wedge mid-scenario cannot cost the remaining pins)
-  7. aligner      — explicit RACON_TPU_DEVICE_ALIGNER=hirschberg bench
-                    at 0.5 Mbp (45 min). Note the default `bench` step
-                    already serves phase 1 through hirschberg when its
-                    bounded probe passes (align_driver default is `auto`);
-                    this step forces it even past a failed probe.
-  8. aligner_host — same bench with RACON_TPU_DEVICE_ALIGNER=host: the
-                    other half of the phase-1 engine decision, same
-                    dataset (45 min)
-  9. jobs2        — wrapper --split --jobs 2 --tpu over the bench
-                    dataset: the multi-host rehearsal (chunk × process
-                    fan-out against one chip — the honest available
-                    approximation of BASELINE config 5) (60 min)
- 10. factor4      — bench with RACON_TPU_NODE_FACTOR=4: deep-window
-                    node capacity (admits the 4 repeat-dense λ windows
-                    the default rejects); its golden re-pin rides the
-                    bench's opportunistic λ pin (45 min)
+  6. pin_<scenario> — one bounded pin_device_golden.py run per scenario
+  7. aligner      — RACON_TPU_DEVICE_ALIGNER=hirschberg bench
+  8. aligner_host — RACON_TPU_DEVICE_ALIGNER=host bench
+  9. jobs2        — wrapper --split --jobs 2 --tpu multi-process rehearsal
+ 10. factor4      — bench with RACON_TPU_NODE_FACTOR=4
 
 Usage:
-    python racon_tpu/tools/hw_session.py           # all steps in order
-    python racon_tpu/tools/hw_session.py bench pins  # a subset
+    python racon_tpu/tools/hw_session.py                # full session
+    python racon_tpu/tools/hw_session.py bench pins     # a subset
+    python racon_tpu/tools/hw_session.py --fresh        # ignore checkpoints
+    python racon_tpu/tools/hw_session.py --retries 2 --backoff 30
 
-Output: stdout + one JSON line per step appended to
-docs/hw_session_log.jsonl (durable, committed — the evidence trail
-survives a tunnel death mid-session).
+This orchestrator stays dependency-free on purpose (no racon_tpu
+imports): it must run, bound, retry, and report even when the package
+itself is broken.  Configuration is therefore CLI flags, not RACON_TPU_*
+knobs.
 """
 
+import argparse
 import json
 import os
+import random
 import signal
 import subprocess
 import sys
@@ -64,6 +69,8 @@ HERE = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 sys.path.insert(0, HERE)
 LOG = os.path.join(HERE, "docs", "hw_session_log.jsonl")
+REPORT = os.path.join(HERE, "docs", "hw_session_report.json")
+STATE_DIR = "/tmp/racon_tpu_hw_session_state"
 
 PROBE = ("import jax, jax.numpy as jnp; "
          "x = jnp.ones((256, 256)); print(float((x @ x).sum())); "
@@ -145,41 +152,45 @@ _aligner_i = next(i for i, (n, *_) in enumerate(STEPS) if n == "aligner")
 STEPS = STEPS[:_aligner_i] + _pin_steps() + STEPS[_aligner_i:]
 
 
-def log_step(entry):
+def log_step(entry, log_path=LOG):
     entry = dict(entry, utc=time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                           time.gmtime()))
     try:
-        with open(LOG, "a") as f:
+        with open(log_path, "a") as f:
             f.write(json.dumps(entry) + "\n")
     except OSError as e:
-        print(f"[hw_session] WARNING: cannot append {LOG}: {e}",
+        print(f"[hw_session] WARNING: cannot append {log_path}: {e}",
               file=sys.stderr)
 
 
-def run_step(name, cmd, bound_s, extra_env):
-    print(f"[hw_session] === {name} (bound {bound_s}s) ===", flush=True)
-    env = dict(os.environ, **extra_env)
+def _checkpoint_path(state_dir, name):
+    return os.path.join(state_dir, f"{name}.json")
+
+
+def _attempt(name, cmd, bound_s, env, cwd):
+    """One bounded attempt.  Returns (outcome, tail, report|None) with
+    outcome in {'ok', 'failed', 'timeout'}."""
     # every polish inside the step writes its resilience run report here
     # (last polish wins); read back into the durable log entry so a
     # silently degraded tier is visible in the evidence trail
     report_path = os.path.join("/tmp", f"racon_tpu_report_{name}_"
                                f"{os.getpid()}.json")
+    env = dict(env)
     env.setdefault("RACON_TPU_REPORT", report_path)
-    t0 = time.time()
     # start_new_session: a timeout must kill the step's WHOLE process
     # group — bench.py runs its own probe subprocesses, and an orphaned
     # probe wedged on the tunnel would hold the device and poison every
     # later step
-    p = subprocess.Popen(cmd, cwd=HERE, env=env, text=True,
+    p = subprocess.Popen(cmd, cwd=cwd, env=env, text=True,
                          stdout=subprocess.PIPE,
                          stderr=subprocess.STDOUT,
                          start_new_session=True)
     try:
         out, _ = p.communicate(timeout=bound_s)
-        ok = p.returncode == 0
+        outcome = "ok" if p.returncode == 0 else "failed"
         tail = (out or "")[-2000:]
     except subprocess.TimeoutExpired:
-        ok = False
+        outcome = "timeout"
         try:
             os.killpg(p.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
@@ -188,40 +199,195 @@ def run_step(name, cmd, bound_s, extra_env):
         # tunnel death ARE the evidence this tool exists to preserve
         out, _ = p.communicate()
         tail = ((out or "")[-2000:] + f"\nTIMEOUT after {bound_s}s")
-    dt = time.time() - t0
-    print(tail, flush=True)
-    print(f"[hw_session] {name}: {'OK' if ok else 'FAILED'} in {dt:.0f}s",
-          flush=True)
-    entry = {"step": name, "ok": ok, "wall_s": round(dt, 1),
-             "env": extra_env, "tail": tail[-600:]}
+    report = None
     try:
         with open(env["RACON_TPU_REPORT"]) as f:
-            entry["report"] = json.load(f)
+            report = json.load(f)
         if env["RACON_TPU_REPORT"] == report_path:
             os.remove(report_path)
     except (OSError, ValueError):
         pass  # step ran no polish (probe/pins) or died before writing
-    log_step(entry)
-    return ok
+    return outcome, tail, report
 
 
-def main():
-    wanted = sys.argv[1:] or [n for n, *_ in STEPS]
+def run_step(name, cmd, bound_s, extra_env, retries=1, backoff_s=10.0,
+             cwd=HERE):
+    """Run one step with bounded attempts + exponential backoff.
+
+    Failures (non-zero exit — a flapping tunnel, transient init errors)
+    are retried; timeouts are not (the bound was already the generous
+    estimate, and a wedged tunnel would burn it again).  Returns the
+    step's log/report entry."""
+    print(f"[hw_session] === {name} (bound {bound_s}s) ===", flush=True)
+    env = dict(os.environ, **extra_env)
+    t0 = time.time()
+    attempts = 0
+    outcome, tail, report = "failed", "", None
+    for k in range(retries + 1):
+        attempts += 1
+        outcome, tail, report = _attempt(name, cmd, bound_s, env, cwd)
+        if outcome != "failed" or k == retries:
+            break
+        # exponential backoff + jitter: give a flapping tunnel room to
+        # settle without stampeding it the moment it comes back
+        delay = backoff_s * (2 ** k) * (1.0 + 0.25 * random.random())
+        print(f"[hw_session] {name}: attempt {attempts} failed; "
+              f"retrying in {delay:.1f}s", flush=True)
+        time.sleep(delay)
+    dt = time.time() - t0
+    print(tail, flush=True)
+    print(f"[hw_session] {name}: {outcome.upper()} in {dt:.0f}s "
+          f"({attempts} attempt(s))", flush=True)
+    entry = {"step": name, "ok": outcome == "ok", "outcome": outcome,
+             "attempts": attempts, "wall_s": round(dt, 1),
+             "env": extra_env, "tail": tail[-600:]}
+    if report is not None:
+        entry["report"] = report
+    return entry
+
+
+def resolve_wanted(names, steps=None):
+    """Expand the 'pins' alias and validate step names."""
+    steps = STEPS if steps is None else steps
+    wanted = list(names) or [n for n, *_ in steps]
     if "pins" in wanted:  # convenience alias for all ten pin steps
         i = wanted.index("pins")
-        wanted[i:i + 1] = [n for n, *_ in STEPS if n.startswith("pin_")]
-    unknown = set(wanted) - {n for n, *_ in STEPS}
+        wanted[i:i + 1] = [n for n, *_ in steps if n.startswith("pin_")]
+    unknown = set(wanted) - {n for n, *_ in steps}
     if unknown:
-        sys.exit(f"unknown steps {sorted(unknown)}; "
-                 f"available: {[n for n, *_ in STEPS]} (or 'pins')")
-    for name, cmd, bound, env in STEPS:
+        raise SystemExit(
+            f"unknown steps {sorted(unknown)}; "
+            f"available: {[n for n, *_ in steps]} (or 'pins')")
+    return wanted
+
+
+def run_session(wanted, steps=None, retries=1, backoff_s=10.0,
+                state_dir=STATE_DIR, fresh=False, log_path=LOG,
+                report_path=REPORT, cwd=HERE):
+    """Run the wanted steps; self-heal around a flaky tunnel; always
+    return (and write) a session report.
+
+    Healing behavior: completed steps checkpoint into `state_dir` and are
+    skipped (`cached`) on a re-run; failed steps retry with backoff; a
+    failed/timed-out probe marks every remaining step `skipped` instead
+    of aborting, so the report still accounts for the whole session."""
+    steps = STEPS if steps is None else steps
+    os.makedirs(state_dir, exist_ok=True)
+    if fresh:
+        for name, *_ in steps:
+            try:
+                os.remove(_checkpoint_path(state_dir, name))
+            except OSError:
+                pass
+    t0 = time.time()
+    outcomes = []
+    tunnel_dead = None   # reason string once the probe proves unhealthy
+    for name, cmd, bound, extra_env in steps:
         if name not in wanted:
             continue
-        ok = run_step(name, cmd, bound, env)
-        if name == "probe" and not ok:
-            sys.exit("[hw_session] tunnel not healthy; aborting (nothing "
-                     "else can succeed)")
+        ckpt = _checkpoint_path(state_dir, name)
+        if os.path.exists(ckpt):
+            try:
+                with open(ckpt) as f:
+                    prev = json.load(f)
+            except (OSError, ValueError):
+                prev = None
+            if prev and prev.get("ok"):
+                print(f"[hw_session] === {name}: cached "
+                      f"(checkpoint {ckpt}) ===", flush=True)
+                entry = {"step": name, "ok": True, "outcome": "cached",
+                         "attempts": 0, "wall_s": 0.0, "env": extra_env,
+                         "checkpoint": ckpt}
+                outcomes.append(entry)
+                log_step(entry, log_path)
+                continue
+        if tunnel_dead is not None:
+            entry = {"step": name, "ok": False, "outcome": "skipped",
+                     "attempts": 0, "wall_s": 0.0, "env": extra_env,
+                     "reason": tunnel_dead}
+            print(f"[hw_session] === {name}: skipped ({tunnel_dead}) ===",
+                  flush=True)
+            outcomes.append(entry)
+            log_step(entry, log_path)
+            continue
+        entry = run_step(name, cmd, bound, extra_env, retries=retries,
+                         backoff_s=backoff_s, cwd=cwd)
+        outcomes.append(entry)
+        log_step(entry, log_path)
+        if entry["ok"]:
+            try:
+                with open(ckpt, "w") as f:
+                    json.dump({"step": name, "ok": True,
+                               "outcome": entry["outcome"],
+                               "wall_s": entry["wall_s"]}, f)
+            except OSError as e:
+                print(f"[hw_session] WARNING: cannot checkpoint {ckpt}: "
+                      f"{e}", file=sys.stderr)
+        elif name == "probe":
+            # the probe is the tunnel's health check: do NOT abort (the
+            # old behavior — it threw away the session report), but do
+            # stop feeding a dead tunnel steps that cannot succeed
+            tunnel_dead = (f"tunnel unhealthy (probe {entry['outcome']} "
+                           f"after {entry['attempts']} attempt(s))")
+    counts = {}
+    for e in outcomes:
+        counts[e["outcome"]] = counts.get(e["outcome"], 0) + 1
+    session = {
+        "session": {
+            "wall_s": round(time.time() - t0, 1),
+            "steps_wanted": len(wanted),
+            "outcomes": counts,
+            "tunnel_dead": tunnel_dead,
+            "state_dir": state_dir,
+        },
+        "steps": [{k: v for k, v in e.items() if k != "tail"}
+                  for e in outcomes],
+    }
+    try:
+        with open(report_path, "w") as f:
+            json.dump(session, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"[hw_session] report: {report_path}", flush=True)
+    except OSError as e:
+        print(f"[hw_session] WARNING: cannot write {report_path}: {e}",
+              file=sys.stderr)
+    log_step({"session_summary": session["session"]}, log_path)
+    return session
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="hw_session.py",
+        description="self-healing TPU hardware measurement session")
+    p.add_argument("steps", nargs="*",
+                   help="step names to run (default: all; 'pins' expands "
+                        "to every pin_<scenario> step)")
+    p.add_argument("--retries", type=int, default=1,
+                   help="extra attempts per failed step (default 1; "
+                        "timeouts are never retried)")
+    p.add_argument("--backoff", type=float, default=10.0, metavar="S",
+                   help="base backoff seconds between retries, doubled "
+                        "per attempt with +0-25%% jitter (default 10)")
+    p.add_argument("--state-dir", default=STATE_DIR,
+                   help=f"per-step checkpoint directory (default "
+                        f"{STATE_DIR}); completed steps are skipped on "
+                        f"re-run")
+    p.add_argument("--fresh", action="store_true",
+                   help="clear checkpoints first: run every step again")
+    p.add_argument("--report", default=REPORT, metavar="PATH",
+                   help="session report path (default docs/"
+                        "hw_session_report.json)")
+    args = p.parse_args(argv)
+    wanted = resolve_wanted(args.steps)
+    session = run_session(wanted, retries=max(0, args.retries),
+                          backoff_s=max(0.0, args.backoff),
+                          state_dir=args.state_dir, fresh=args.fresh,
+                          report_path=args.report)
+    # exit 0 as long as the session produced evidence; 1 only when
+    # nothing ran to completion at all
+    ok_any = any(e["ok"] for e in session["steps"])
+    return 0 if ok_any else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -6,3 +6,15 @@ from racon_tpu.resilience import faults
 def run(chunk):
     faults.check("poa.run.no_such_tier", chunk)
     return chunk
+
+
+def journal_typo(chunk):
+    # resilience-layer points are registered too: "journal.append" /
+    # "journal.replay" are known, this misspelling is not
+    faults.check("journal.appendd", chunk)
+    return chunk
+
+
+def watchdog_typo(chunk):
+    faults.check("watchdog.calls", chunk)
+    return chunk

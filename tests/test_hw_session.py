@@ -1,0 +1,163 @@
+"""Self-healing hardware-session orchestrator: checkpoints, retry with
+backoff, step timeouts, and the no-abort partial-session report — all
+driven with fake steps in bounded subprocesses (no device needed).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from racon_tpu.tools import hw_session
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _step(name, code, bound=30, env=None):
+    return (name, [sys.executable, "-c", code], bound, env or {})
+
+
+def _session(tmp_path, steps, wanted=None, **kw):
+    kw.setdefault("retries", 0)
+    kw.setdefault("backoff_s", 0.01)
+    return hw_session.run_session(
+        wanted if wanted is not None else [n for n, *_ in steps],
+        steps=steps,
+        state_dir=str(tmp_path / "state"),
+        log_path=str(tmp_path / "log.jsonl"),
+        report_path=str(tmp_path / "report.json"),
+        cwd=str(tmp_path), **kw)
+
+
+def _outcomes(session):
+    return {e["step"]: e["outcome"] for e in session["steps"]}
+
+
+def test_ok_step_checkpoints_then_caches(tmp_path):
+    steps = [_step("a", "print('hi')")]
+    s1 = _session(tmp_path, steps)
+    assert _outcomes(s1) == {"a": "ok"}
+    assert os.path.exists(tmp_path / "state" / "a.json")
+    s2 = _session(tmp_path, steps)          # resumed session: skip, don't rerun
+    assert _outcomes(s2) == {"a": "cached"}
+    s3 = _session(tmp_path, steps, fresh=True)
+    assert _outcomes(s3) == {"a": "ok"}
+    # the report file accounts for the session either way
+    with open(tmp_path / "report.json") as f:
+        rep = json.load(f)
+    assert rep["session"]["outcomes"] == {"ok": 1}
+    assert rep["session"]["tunnel_dead"] is None
+
+
+def test_flaky_step_retried_with_backoff(tmp_path):
+    marker = tmp_path / "flaked"
+    code = (f"import os, sys\n"
+            f"p = {str(marker)!r}\n"
+            f"if os.path.exists(p): sys.exit(0)\n"
+            f"open(p, 'w').close(); sys.exit(1)\n")
+    s = _session(tmp_path, [_step("flaky", code)], retries=1)
+    (entry,) = s["steps"]
+    assert entry["outcome"] == "ok" and entry["attempts"] == 2
+
+
+def test_failed_step_exhausts_retries(tmp_path):
+    s = _session(tmp_path, [_step("bad", "import sys; sys.exit(3)")],
+                 retries=1)
+    (entry,) = s["steps"]
+    assert entry["outcome"] == "failed" and entry["attempts"] == 2
+    assert not os.path.exists(tmp_path / "state" / "bad.json")
+
+
+def test_timeout_kills_step_and_is_not_retried(tmp_path):
+    s = _session(tmp_path,
+                 [_step("wedge", "import time; time.sleep(60)", bound=1)],
+                 retries=2)
+    (entry,) = s["steps"]
+    # the bound was already the generous estimate: one attempt only
+    assert entry["outcome"] == "timeout" and entry["attempts"] == 1
+    assert entry["wall_s"] < 30
+
+
+def test_probe_death_skips_rest_but_still_reports(tmp_path):
+    steps = [_step("probe", "import sys; sys.exit(1)"),
+             _step("bench", "print('never runs')"),
+             _step("pins", "print('never runs either')")]
+    s = _session(tmp_path, steps)
+    assert _outcomes(s) == {"probe": "failed", "bench": "skipped",
+                            "pins": "skipped"}
+    assert "tunnel unhealthy" in s["session"]["tunnel_dead"]
+    for e in s["steps"][1:]:
+        assert "tunnel unhealthy" in e["reason"]
+    # the partial-session report still lands on disk — the whole point
+    with open(tmp_path / "report.json") as f:
+        rep = json.load(f)
+    assert rep["session"]["outcomes"] == {"failed": 1, "skipped": 2}
+
+
+def test_cached_probe_does_not_unlock_a_dead_tunnel_twice(tmp_path):
+    # checkpointed steps are skipped BEFORE the tunnel_dead gate: a
+    # cached success never masks a later probe failure
+    steps = [_step("probe", "import sys; sys.exit(1)"),
+             _step("b", "print('x')")]
+    s1 = _session(tmp_path, steps, wanted=["b"])
+    assert _outcomes(s1) == {"b": "ok"}
+    s2 = _session(tmp_path, steps)
+    assert _outcomes(s2) == {"probe": "failed", "b": "cached"}
+
+
+def test_resolve_wanted_expands_pins_and_rejects_unknown():
+    steps = [("probe", [], 1, {}), ("pin_a", [], 1, {}),
+             ("pin_b", [], 1, {}), ("bench", [], 1, {})]
+    assert hw_session.resolve_wanted([], steps) == [
+        "probe", "pin_a", "pin_b", "bench"]
+    assert hw_session.resolve_wanted(["pins", "bench"], steps) == [
+        "pin_a", "pin_b", "bench"]
+    with pytest.raises(SystemExit):
+        hw_session.resolve_wanted(["bogus"], steps)
+
+
+def test_fault_killed_polish_yields_partial_session_report(tmp_path):
+    """ISSUE acceptance: a session whose polish dies under
+    RACON_TPU_FAULT still completes and writes a partial report."""
+    import random
+    rng = random.Random(11)
+    with open(tmp_path / "t.fasta", "w") as tf, \
+            open(tmp_path / "r.fasta", "w") as rf, \
+            open(tmp_path / "ovl.paf", "w") as of:
+        seq = "".join(rng.choice("ACGT") for _ in range(200))
+        tf.write(f">t0\n{seq}\n")
+        for i in range(4):
+            rf.write(f">r{i}\n{seq}\n")
+            of.write(f"r{i}\t200\t0\t200\t+\tt0\t200\t0\t200\t200\t200\t60\n")
+    polish = [sys.executable, "-m", "racon_tpu.cli", "-w", "100",
+              "--journal", str(tmp_path / "j.jsonl"),
+              str(tmp_path / "r.fasta"), str(tmp_path / "ovl.paf"),
+              str(tmp_path / "t.fasta")]
+    steps = [("polish", polish, 60,
+              {"JAX_PLATFORMS": "cpu",
+               "RACON_TPU_FAULT": "journal.append:batch=1:kill=1"}),
+             _step("after", "print('still reachable')")]
+    s = hw_session.run_session(
+        ["polish", "after"], steps=steps, retries=0, backoff_s=0.01,
+        state_dir=str(tmp_path / "state"),
+        log_path=str(tmp_path / "log.jsonl"),
+        report_path=str(tmp_path / "report.json"), cwd=ROOT)
+    # SIGKILL mid-append: the step fails, the session neither hangs nor
+    # aborts, and the next step still runs
+    assert _outcomes(s) == {"polish": "failed", "after": "ok"}
+    with open(tmp_path / "report.json") as f:
+        rep = json.load(f)
+    assert rep["session"]["outcomes"] == {"failed": 1, "ok": 1}
+    # the killed run left a resumable journal prefix behind
+    with open(tmp_path / "j.jsonl") as f:
+        assert len(f.read().splitlines()) >= 1
+
+
+def test_session_log_is_appended_jsonl(tmp_path):
+    _session(tmp_path, [_step("a", "print('x')")])
+    with open(tmp_path / "log.jsonl") as f:
+        lines = [json.loads(line) for line in f if line.strip()]
+    assert any(e.get("step") == "a" for e in lines)
+    assert any("session_summary" in e for e in lines)
+    assert all("utc" in e for e in lines)

@@ -1,0 +1,319 @@
+"""Preemption tolerance: the crash-safe window journal, SIGKILL resume,
+the wedge classifier, and the bulk align-job-lengths FFI.
+
+The headline contract (ISSUE acceptance): a polish killed mid-run with
+SIGKILL, resumed via `--resume-journal`, produces byte-identical output
+to an uninterrupted run, and the run report counts resumed vs freshly
+computed windows.  Everything here runs on the CPU backend in tier-1.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import racon_tpu
+from racon_tpu.pipeline import Pipeline
+from racon_tpu.resilience import faults, lattice, watchdog
+from racon_tpu.resilience.journal import Journal, input_fingerprint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+sys.path.insert(0, ROOT)  # for `import bench` (repo-root script)
+
+_ARGS = dict(window_length=100, quality_threshold=10, error_threshold=0.3,
+             match=5, mismatch=-4, gap=-8, num_threads=1)
+
+
+def _write_dataset(tmp_path, n_targets=3, n_reads=4):
+    """Identical-read PAF dataset (same shape as test_faults.py): w=100
+    over 200 bp targets -> 6 windows, all byte-stable across backends."""
+    import random
+    rng = random.Random(11)
+    with open(tmp_path / "targets.fasta", "w") as tf, \
+            open(tmp_path / "reads.fasta", "w") as rf, \
+            open(tmp_path / "ovl.paf", "w") as of:
+        for t in range(n_targets):
+            seq = "".join(rng.choice("ACGT") for _ in range(200))
+            tf.write(f">t{t}\n{seq}\n")
+            for i in range(n_reads):
+                rf.write(f">t{t}r{i}\n{seq}\n")
+                of.write(f"t{t}r{i}\t200\t0\t200\t+\tt{t}\t200\t0\t200"
+                         f"\t200\t200\t60\n")
+    return (str(tmp_path / "reads.fasta"), str(tmp_path / "ovl.paf"),
+            str(tmp_path / "targets.fasta"))
+
+
+def _cli(paths, *extra, env=None, window=100):
+    cmd = [sys.executable, "-m", "racon_tpu.cli",
+           "-w", str(window), "-q", "10", "-e", "0.3",
+           "-m", "5", "-x", "-4", "-g", "-8", *extra, *paths]
+    full_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    full_env.pop("RACON_TPU_FAULT", None)
+    full_env.update(env or {})
+    return subprocess.run(cmd, cwd=ROOT, env=full_env, capture_output=True)
+
+
+# ------------------------------------------------------------ unit: faults
+
+def test_new_fault_points_registered():
+    assert {"journal.append", "journal.replay",
+            "watchdog.call"} <= faults.KNOWN_POINTS
+
+
+def test_parse_kill_spec():
+    (spec,) = faults.parse_spec("journal.append:batch=3:kill=1")
+    assert spec.kill and spec.batch == 3
+    (spec,) = faults.parse_spec("journal.append:kill=0")
+    assert not spec.kill
+    with pytest.raises(ValueError):
+        faults.parse_spec("journal.append:kill=x")
+
+
+# ------------------------------------------------------- unit: fingerprint
+
+def test_fingerprint_sensitivity(tmp_path):
+    paths = _write_dataset(tmp_path)
+    fp = input_fingerprint(paths, _ARGS, "cpu")
+    assert fp == input_fingerprint(paths, _ARGS, "cpu")
+    assert fp != input_fingerprint(paths, _ARGS, "tpu")
+    assert fp != input_fingerprint(paths, dict(_ARGS, window_length=50),
+                                   "cpu")
+    # thread count legally varies between the killed and resumed run
+    assert fp == input_fingerprint(paths, dict(_ARGS, num_threads=8), "cpu")
+    with open(paths[0], "a") as f:
+        f.write(">extra\nACGT\n")
+    assert fp != input_fingerprint(paths, _ARGS, "cpu")
+
+
+def test_journal_roundtrip_and_torn_tail(tmp_path):
+    jp = str(tmp_path / "j.jsonl")
+    j = Journal(jp, "f" * 64)
+    j.append_window(0, 0, 3, "xla", b"ACGT", True)
+    j.append_cigar(2, "hirschberg", "4=")
+    j.close()
+    r = Journal(jp, "f" * 64, resume=True)
+    assert r.resumed
+    assert r.windows[0].payload == b"ACGT" and r.windows[0].polished
+    assert r.cigars[2].cigar == "4="
+    r.close()
+    # chop mid-record: the torn tail is dropped, the prefix survives
+    size = os.path.getsize(jp)
+    with open(jp, "r+b") as f:
+        f.truncate(size - 5)
+    t = Journal(jp, "f" * 64, resume=True)
+    assert t.windows[0].payload == b"ACGT" and 2 not in t.cigars
+    t.close()
+
+
+def test_journal_fingerprint_mismatch_modes(tmp_path):
+    jp = str(tmp_path / "j.jsonl")
+    Journal(jp, "a" * 64).close()
+    from racon_tpu.resilience.journal import JournalError
+    with pytest.raises(JournalError):
+        Journal(jp, "b" * 64, resume=True, on_mismatch="error")
+    fresh = Journal(jp, "b" * 64, resume=True, on_mismatch="fresh")
+    assert not fresh.resumed and not fresh.windows
+    fresh.close()
+
+
+# --------------------------------------------- e2e: SIGKILL -> resume (CLI)
+
+def test_sigkill_mid_polish_resume_byte_identical(tmp_path):
+    """The acceptance criterion: kill -9 mid-run, resume, same bytes."""
+    paths = _write_dataset(tmp_path)
+    baseline = _cli(paths)
+    assert baseline.returncode == 0, baseline.stderr.decode()
+
+    jp = str(tmp_path / "run.journal")
+    killed = _cli(paths, "--journal", jp,
+                  env={"RACON_TPU_FAULT": "journal.append:batch=3:kill=1"})
+    assert killed.returncode == -9        # died by SIGKILL, not cleanly
+    with open(jp) as f:
+        lines = f.read().splitlines()
+    assert 1 < len(lines) < 7             # header + a strict subset served
+
+    rp = str(tmp_path / "resume_report.json")
+    resumed = _cli(paths, "--resume-journal", jp, "--report", rp)
+    assert resumed.returncode == 0, resumed.stderr.decode()
+    assert resumed.stdout == baseline.stdout
+    with open(rp) as f:
+        cons = json.load(f)["phases"]["consensus"]
+    assert cons["served"]["journal"] == len(lines) - 1
+    assert cons["served"]["journal"] + cons["served"]["host"] == 6
+
+
+def test_resume_with_torn_last_line(tmp_path):
+    paths = _write_dataset(tmp_path)
+    baseline = _cli(paths)
+    jp = str(tmp_path / "run.journal")
+    full = _cli(paths, "--journal", jp)
+    assert full.returncode == 0 and full.stdout == baseline.stdout
+    size = os.path.getsize(jp)
+    with open(jp, "r+b") as f:
+        f.truncate(size - 10)             # SIGKILL mid-append simulacrum
+    resumed = _cli(paths, "--resume-journal", jp)
+    assert resumed.returncode == 0
+    assert resumed.stdout == baseline.stdout
+    assert b"torn trailing" in resumed.stderr
+
+
+def test_resume_wrong_params_refused(tmp_path):
+    paths = _write_dataset(tmp_path)
+    jp = str(tmp_path / "run.journal")
+    assert _cli(paths, "--journal", jp).returncode == 0
+    r = _cli(paths, "--resume-journal", jp, window=50)
+    assert r.returncode == 1
+    err = r.stderr.decode()
+    assert "refusing to resume" in err
+    assert "Traceback" not in err         # single-line contract
+
+
+# ------------------------------------------ e2e: device-path journal resume
+
+def test_tpu_journal_resume_mixes_tiers(tmp_path, monkeypatch):
+    paths = _write_dataset(tmp_path)
+    for k, v in {"RACON_TPU_PALLAS": "0", "RACON_TPU_POA_KERNEL": "v2",
+                 "RACON_TPU_BATCH_WINDOWS": "8"}.items():
+        monkeypatch.setenv(k, v)
+    jp = str(tmp_path / "run.journal")
+    p = racon_tpu.create_polisher(*paths, backend="tpu", journal_path=jp,
+                                  **_ARGS)
+    p.initialize()
+    oracle = p.polish(True)
+    assert p.report.as_dict()["phases"]["consensus"]["served"]["xla"] == 6
+
+    # keep header + 3 window records: a run killed mid-batch
+    with open(jp) as f:
+        lines = f.read().splitlines(keepends=True)
+    with open(jp, "w") as f:
+        f.writelines(lines[:4])
+
+    p2 = racon_tpu.create_polisher(*paths, backend="tpu", journal_path=jp,
+                                   resume_journal=True, **_ARGS)
+    p2.initialize()
+    assert p2.polish(True) == oracle
+    cons = p2.report.as_dict()["phases"]["consensus"]
+    assert cons["served"]["journal"] == 3 and cons["served"]["xla"] == 3
+
+    # the resumed journal is now complete: a third run replays everything
+    p3 = racon_tpu.create_polisher(*paths, backend="tpu", journal_path=jp,
+                                   resume_journal=True, **_ARGS)
+    p3.initialize()
+    assert p3.polish(True) == oracle
+    cons = p3.report.as_dict()["phases"]["consensus"]
+    assert cons["served"]["journal"] == 6 and cons["served"]["xla"] == 0
+
+
+def test_env_knob_arms_autoresume(tmp_path, monkeypatch):
+    paths = _write_dataset(tmp_path)
+    jp = str(tmp_path / "auto.journal")
+    monkeypatch.setenv("RACON_TPU_JOURNAL", jp)
+    p = racon_tpu.create_polisher(*paths, backend="cpu", **_ARGS)
+    p.initialize()
+    oracle = p.polish(True)
+    with open(jp) as f:
+        assert len(f.read().splitlines()) == 7   # header + 6 windows
+    p2 = racon_tpu.create_polisher(*paths, backend="cpu", **_ARGS)
+    p2.initialize()
+    assert p2.polish(True) == oracle
+    cons = p2.report.as_dict()["phases"]["consensus"]
+    assert cons["served"]["journal"] == 6 and cons["served"]["host"] == 0
+
+
+# -------------------------------------------------------------- unit: wedge
+
+def test_wedge_tracker_streaks(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_WEDGE_LIMIT", "2")
+    t = watchdog.WedgeTracker()
+    assert t.record_timeout("xla") == 1 and not t.is_wedged("xla")
+    t.record_success("xla")               # slow-but-alive clears the streak
+    assert t.streak("xla") == 0
+    t.record_timeout("xla")
+    t.record_timeout("xla")
+    assert t.is_wedged("xla") and not t.is_wedged("ls")
+    monkeypatch.setenv("RACON_TPU_WEDGE_LIMIT", "0")
+    assert not t.is_wedged("xla")         # 0 disables classification
+
+
+def test_wedged_tier_short_circuits_lattice(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_WEDGE_LIMIT", "2")
+    watchdog.reset()
+    watchdog.tracker().record_timeout("xla")
+    watchdog.tracker().record_timeout("xla")
+    calls = []
+    with pytest.raises(lattice.TierWedged):
+        lattice.serve_with_bisect([1, 2], lambda sub: calls.append(sub),
+                                  tier="xla", retries=3)
+    assert not calls                      # no deadline burned on a wedge
+    watchdog.reset()
+
+
+def test_wedged_tier_degrades_to_host_e2e(tmp_path, monkeypatch):
+    paths = _write_dataset(tmp_path)
+    p0 = racon_tpu.create_polisher(*paths, backend="cpu", **_ARGS)
+    p0.initialize()
+    oracle = p0.polish(True)
+    for k, v in {"RACON_TPU_PALLAS": "0", "RACON_TPU_POA_KERNEL": "v2",
+                 "RACON_TPU_BATCH_WINDOWS": "8",
+                 "RACON_TPU_DEVICE_TIMEOUT": "0.3",
+                 "RACON_TPU_WEDGE_LIMIT": "2",
+                 # invocation 0 (pipelined submit) fails synchronously so
+                 # the lattice's retries run under the watchdog; every
+                 # later invocation hangs -> two consecutive timeouts ->
+                 # wedged -> demote, instead of one deadline per retry
+                 "RACON_TPU_FAULT": ("poa.run.xla:batch=0:count=1,"
+                                     "poa.run.xla:hang=1")}.items():
+        monkeypatch.setenv(k, v)
+    p = racon_tpu.create_polisher(*paths, backend="tpu", **_ARGS)
+    p.initialize()
+    assert p.polish(True) == oracle
+    cons = p.report.as_dict()["phases"]["consensus"]
+    assert cons["served"]["host"] == 6
+    assert any(d["from"] == "xla" and d["to"] == "host"
+               for d in cons["degradations"])
+    assert "WatchdogTimeout" in json.dumps(cons["causes"])
+
+
+# --------------------------------------------------------- unit: bulk FFI
+
+def test_align_job_lengths_bulk_matches_loop(tmp_path):
+    paths = _write_dataset(tmp_path)
+    p = Pipeline(*paths, **_ARGS)
+    p.prepare()
+    assert p.num_align_jobs() > 0
+    bulk = p.align_job_lengths()
+    loop = p._align_job_lengths_loop()
+    assert bulk.dtype == np.uint32 and bulk.shape == loop.shape
+    assert np.array_equal(bulk, loop)
+    assert int(bulk[0, 0]) == 200 and int(bulk[0, 1]) == 200
+
+
+# ------------------------------------------------------ unit: bench honesty
+
+def test_bench_normalize_entry_backfills_unreachable():
+    import bench
+    old = {"metric": "Mbp/s [TPU UNREACHABLE: host path only]",
+           "value": 0.01, "vs_baseline": 0.0}
+    fixed = bench.normalize_entry(old)
+    assert fixed["vs_baseline"] is None
+    assert fixed["device_status"] == "unreachable"
+    assert old["vs_baseline"] == 0.0      # input not mutated
+    # a measured zero on a reachable device is a real measurement
+    measured = {"metric": "Mbp/s (device)", "value": 0.0,
+                "vs_baseline": 0.0}
+    assert bench.normalize_entry(measured)["vs_baseline"] == 0.0
+    assert "device_status" not in bench.normalize_entry(measured)
+
+
+def test_bench_degraded_result_is_null_not_zero():
+    import bench
+    e = bench.degraded_result(1.25, "; note")
+    assert e["vs_baseline"] is None
+    assert e["device_status"] == "unreachable"
+    assert "TPU UNREACHABLE" in e["metric"]
+    # round-trips through the reader unchanged
+    assert bench.normalize_entry(json.loads(json.dumps(e))) == e

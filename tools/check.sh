@@ -39,6 +39,22 @@ else
         env JAX_PLATFORMS=cpu python -m racon_tpu.analysis
 fi
 
+# 1b. Focused lint over the preemption-tolerance modules: these carry
+#     the crash-resume contract (journal/watchdog/hw_session) and the
+#     drivers that feed the journal, so their fault points / knob docs /
+#     broad-except waivers must stay lint-clean even when a full-tree
+#     run is baselined.
+run "racon_tpu.analysis (resilience focus)" \
+    env JAX_PLATFORMS=cpu python -m racon_tpu.analysis --paths \
+        racon_tpu/resilience/journal.py \
+        racon_tpu/resilience/watchdog.py \
+        racon_tpu/resilience/faults.py \
+        racon_tpu/resilience/lattice.py \
+        racon_tpu/tools/hw_session.py \
+        racon_tpu/ops/poa_driver.py \
+        racon_tpu/ops/align_driver.py \
+        racon_tpu/polisher.py
+
 # 2. ruff (style + pyflakes), configured in pyproject.toml.
 if command -v ruff >/dev/null 2>&1; then
     run "ruff" ruff check .
