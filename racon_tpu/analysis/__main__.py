@@ -16,6 +16,39 @@ from typing import List
 from . import jaxpr_audit, lint
 
 
+def _sanitize_report(path: str, as_json: bool) -> int:
+    """Render the `sanitize` section of a run report; exit 1 on any
+    recorded finding (the dynamic-analysis analogue of the lint gate)."""
+    try:
+        with open(path) as f:
+            report = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"[analysis] cannot read run report {path}: {e}",
+              file=sys.stderr)
+        return 2
+    section = report.get("sanitize")
+    if not isinstance(section, dict):
+        print(f"[analysis] {path}: no `sanitize` section (report predates "
+              f"the runtime sanitizer?)", file=sys.stderr)
+        return 2
+    armed = bool(section.get("armed"))
+    findings = section.get("findings") or []
+    if as_json:
+        print(json.dumps({"armed": armed, "findings": findings}, indent=2))
+        return 1 if findings else 0
+    for f in findings:
+        times = f" x{f['count']}" if f.get("count", 1) > 1 else ""
+        print(f"[sanitize] {f.get('kind', '?')} at {f.get('where', '?')}"
+              f"{times}: {f.get('detail', '')}")
+    if findings:
+        print(f"[analysis] SANITIZE FAIL: {len(findings)} distinct "
+              f"finding(s)")
+        return 1
+    state = "armed" if armed else "not armed (RACON_TPU_SANITIZE unset)"
+    print(f"[analysis] SANITIZE OK: no findings; sanitizer {state}")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m racon_tpu.analysis",
@@ -44,7 +77,14 @@ def main(argv=None) -> int:
                    help="machine-readable output")
     p.add_argument("--list-rules", action="store_true",
                    help="print every rule id + summary and exit")
+    p.add_argument("--sanitize-report", default=None, metavar="FILE",
+                   help="render the runtime-sanitizer verdict from a run "
+                        "report JSON (see RACON_TPU_REPORT / --report); "
+                        "exit 1 when the run recorded sanitizer findings")
     args = p.parse_args(argv)
+
+    if args.sanitize_report:
+        return _sanitize_report(args.sanitize_report, args.as_json)
 
     if args.list_rules:
         from .rules import ALL_RULES

@@ -45,6 +45,7 @@ from .caching import KernelCacheKeyRule       # noqa: E402
 from .knobs import EnvRegistryRule, KnobDocsRule  # noqa: E402
 from .faultpoints import FaultPointRule       # noqa: E402
 from .excepts import DeviceExceptRule         # noqa: E402
+from .clock import WallClockRule              # noqa: E402
 
 #: All rules, in documentation order.
 ALL_RULES = (
@@ -54,6 +55,7 @@ ALL_RULES = (
     KnobDocsRule(),
     FaultPointRule(),
     DeviceExceptRule(),
+    WallClockRule(),
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -88,6 +88,13 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
     _k("RACON_TPU_JOURNAL_FSYNC", "1", "bool",
        "fsync the journal after every record (0 trades durability for "
        "speed: a crash may lose buffered records)"),
+    _k("RACON_TPU_SANITIZE", None, "bool",
+       "runtime sanitizer: finite/in-range device-output checks, "
+       "sampled host-vs-device parity, guarded driver stats "
+       "(diagnostic mode; output stays byte-identical)"),
+    _k("RACON_TPU_SANITIZE_PARITY", "8", "int",
+       "sanitize mode: host-recompute and byte-compare every Nth "
+       "device-served window (0 disables the parity probe)"),
     # -- test / bench knobs ----------------------------------------------
     _k("RACON_TPU_HW_TESTS", None, "bool",
        "assert exact on-hardware pins against a real TPU backend",

@@ -144,6 +144,7 @@ def run_jobs(pipeline, jobs, batch: int = 16, report=None) -> int:
     count stays accurate for whatever was already installed."""
     import sys
 
+    from ..analysis import sanitize
     from ..resilience import faults
     from ..resilience import lattice as rl
 
@@ -186,6 +187,9 @@ def run_jobs(pipeline, jobs, batch: int = 16, report=None) -> int:
                 pairs_results, quarantined = rl.serve_with_bisect(
                     chunk, attempt, tier="xla", report=report)
                 for sub, (ops, cnt, ok) in pairs_results:
+                    if sanitize.enabled():
+                        sanitize.check_align_outputs(
+                            ops, cnt, ok, where="align.run_jobs")
                     for bi, job in enumerate(sub):
                         if not ok[bi]:
                             continue  # host will align it

@@ -64,13 +64,16 @@ def run_alignment_phase(pipeline, progress: bool = False,
     Returns stats {device:…, host:…, report: PhaseReport} — the report's
     per-tier served counts sum to the job count, clean or
     fault-injected."""
+    from ..analysis import sanitize
     from ..resilience import faults
     from ..resilience import lattice as rl
     from ..resilience.journal import CigarTap, replay_cigars
     from ..resilience.report import PhaseReport
 
     report = PhaseReport("alignment", rl.ALIGN_TIERS + ("journal",))
-    stats = {"device": 0, "host": 0, "report": report}
+    # guard_stats is a no-op passthrough unless RACON_TPU_SANITIZE=1.
+    stats = sanitize.guard_stats({"device": 0, "host": 0, "report": report},
+                                 "align_driver.run_alignment_phase")
     n = pipeline.num_align_jobs()
     report.total = n
     replayed = replay_cigars(pipeline, journal, n, report)

@@ -36,7 +36,17 @@ def device_keyed_cache(maxsize: int = 64):
             import jax
 
             devs = jax.devices()
-            return cached(len(devs), devs[0].platform, *args, **kwargs)
+            built = cached(len(devs), devs[0].platform, *args, **kwargs)
+            # Opt-in runtime sanitizer (RACON_TPU_SANITIZE=1): hand the
+            # built kernel back wrapped in a checking proxy. Imported
+            # lazily at call time — by the first kernel build the
+            # analysis package is safe to import, while a module-level
+            # import here would run analysis/__init__ during ops import.
+            from ..analysis import sanitize
+
+            if sanitize.enabled():
+                return sanitize.wrap_kernel(build.__name__, built)
+            return built
 
         wrapper.cache_clear = cached.cache_clear
         wrapper.cache_info = cached.cache_info

@@ -38,6 +38,14 @@ from .encoding import decode, encode
 DEPTH_CAP = 200                    # reference: MAX_DEPTH_PER_WINDOW
 DEPTH_BUCKETS = (8, 32, DEPTH_CAP)
 
+
+def _sanitize():
+    """The runtime sanitizer module (lazy: the analysis package must not
+    load on the production import path).  Its entry points self-gate on
+    RACON_TPU_SANITIZE, so callers just call through."""
+    from ..analysis import sanitize
+    return sanitize
+
 _PALLAS_KINDS = ("ls", "v2")
 
 #: The window lengths the static jaxpr audit traces the consensus kernel
@@ -173,6 +181,9 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
     report.total = n
     stats = {"device": 0, "host_fallback": 0, "backbone": 0, "failed": 0,
              "layers_dropped": 0, "report": report}
+    # Runtime-sanitizer guard (no-op passthrough when unarmed): flags
+    # stats mutations from any thread but this driver thread.
+    stats = _sanitize().guard_stats(stats, "poa_driver.run_consensus_phase")
 
     replayed = replay_windows(pipeline, journal, n, report)
 
@@ -677,6 +688,14 @@ def _unpack(outs, use_pallas):
 
 def _install(pipeline, chunk, results, trim, stats, fallback, report=None,
              tier=None, journal=None):
+    san = _sanitize()
+    sanitizing = san.enabled()
+    if sanitizing:
+        # Concrete-side invariants (the kernel proxy skips traced calls):
+        # in-range lengths/codes, boolean failed flags. The sanitize.nan
+        # fault fires in here against a checker-only copy.
+        san.check_consensus_outputs(results, [i for i, _, _ in chunk],
+                                    where=f"poa._install[{tier or 'device'}]")
     cons_base, cons_cov, cons_len, failed = results
     for bi, (i, wx, keep) in enumerate(chunk):
         if failed[bi]:
@@ -704,6 +723,17 @@ def _install(pipeline, chunk, results, trim, stats, fallback, report=None,
         else:
             kept_codes = out
         payload = decode(kept_codes)
+        if sanitizing and san.parity_due(stats["device"]):
+            # Sampled host<->device parity. Host trim parity holds exactly
+            # when no layers were dropped at admission (see the trim
+            # comment above), so deeper windows are skipped. Recompute
+            # BEFORE the install below so the device result is what
+            # finally lands — an armed run stays byte-identical.
+            n_seqs = pipeline.window_info(i)[0]
+            if len(keep) + 1 == n_seqs:
+                pipeline.consensus_cpu_one(i)
+                san.check_parity(payload, pipeline.get_consensus(i), i,
+                                 where=f"poa._install[{tier or 'device'}]")
         pipeline.set_consensus(i, payload, True)
         if journal is not None:
             journal.append_window(i, wx.target_id, wx.rank,

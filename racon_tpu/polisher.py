@@ -53,6 +53,8 @@ class CpuPolisher:
                  resume_journal: bool = False, **kwargs):
         faults.reset()     # per-run firing schedule (deterministic)
         watchdog.reset()   # per-run wedge streaks
+        from .analysis import sanitize
+        sanitize.reset()   # per-run sanitizer findings
         self._journal = _open_journal(
             (sequences_path, overlaps_path, target_path), "cpu",
             journal_path, resume_journal, kwargs)
@@ -112,6 +114,8 @@ class TpuPolisher:
                  resume_journal: bool = False, **kwargs):
         faults.reset()     # per-run firing schedule (deterministic)
         watchdog.reset()   # per-run wedge streaks
+        from .analysis import sanitize
+        sanitize.reset()   # per-run sanitizer findings
         self._kwargs = dict(kwargs)
         self._journal = _open_journal(
             (sequences_path, overlaps_path, target_path), "tpu",

@@ -71,6 +71,10 @@ KNOWN_POINTS = frozenset({
     "journal.append",    # durable-journal record write (resilience/journal)
     "journal.replay",    # journal replay on --resume-journal
     "watchdog.call",     # device-dispatch entry under the watchdog
+    "sanitize.nan",      # sanitizer: poison the checker's COPY of one
+                         # consensus buffer (polish output untouched)
+    "sanitize.stats",    # sanitizer: one real cross-thread stats-dict
+                         # mutation through the guard
 })
 
 

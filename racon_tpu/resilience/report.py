@@ -93,7 +93,10 @@ class RunReport:
 
     def __init__(self):
         self.phases = {}
-        self._t0 = time.time()
+        # monotonic: a wall-clock (time.time) duration goes negative or
+        # balloons across an NTP step; the wall-clock lint rule
+        # (analysis/rules/clock.py) enforces this repo-wide
+        self._t0 = time.monotonic()
         self.wall_s = None
 
     def attach(self, phase_report: Optional[PhaseReport]) -> None:
@@ -101,10 +104,11 @@ class RunReport:
             self.phases[phase_report.phase] = phase_report
 
     def finalize(self) -> "RunReport":
-        self.wall_s = time.time() - self._t0
+        self.wall_s = time.monotonic() - self._t0
         return self
 
     def as_dict(self) -> dict:
+        from ..analysis import sanitize
         from .faults import active_spec
 
         return {
@@ -114,8 +118,13 @@ class RunReport:
             # but unknown to the config registry — a typo'd knob surfaces
             # here instead of being silently ignored
             "unknown_knobs": config.unknown_env_knobs(),
+            # runtime-sanitizer verdict: armed flag + structured findings
+            # (rendered by `python -m racon_tpu.analysis
+            # --sanitize-report REPORT.json`)
+            "sanitize": {"armed": sanitize.enabled(),
+                         "findings": sanitize.as_dicts()},
             "wall_s": round(self.wall_s if self.wall_s is not None
-                            else time.time() - self._t0, 3),
+                            else time.monotonic() - self._t0, 3),
         }
 
     def summary(self) -> dict:

@@ -107,12 +107,12 @@ STEPS = [
         "sys.path.insert(0, '.')\n"
         "import bench\n"
         "paths = bench.dataset()\n"
-        "t0 = time.time()\n"
+        "t0 = time.monotonic()\n"
         "r = subprocess.run([sys.executable, '-m',"
         " 'racon_tpu.tools.wrapper', paths['reads'], paths['overlaps'],"
         " paths['draft'], '--split', '200000', '--jobs', '2', '--tpu'],"
         " capture_output=True, text=True)\n"
-        "dt = time.time() - t0\n"
+        "dt = time.monotonic() - t0\n"
         "sys.stderr.write(r.stderr[-1500:])\n"
         "bp = sum(len(l.strip()) for l in r.stdout.splitlines()"
         " if not l.startswith('>'))\n"
@@ -220,7 +220,8 @@ def run_step(name, cmd, bound_s, extra_env, retries=1, backoff_s=10.0,
     step's log/report entry."""
     print(f"[hw_session] === {name} (bound {bound_s}s) ===", flush=True)
     env = dict(os.environ, **extra_env)
-    t0 = time.time()
+    # monotonic: elapsed/backoff accounting must not jump with NTP steps
+    t0 = time.monotonic()
     attempts = 0
     outcome, tail, report = "failed", "", None
     for k in range(retries + 1):
@@ -234,7 +235,7 @@ def run_step(name, cmd, bound_s, extra_env, retries=1, backoff_s=10.0,
         print(f"[hw_session] {name}: attempt {attempts} failed; "
               f"retrying in {delay:.1f}s", flush=True)
         time.sleep(delay)
-    dt = time.time() - t0
+    dt = time.monotonic() - t0
     print(tail, flush=True)
     print(f"[hw_session] {name}: {outcome.upper()} in {dt:.0f}s "
           f"({attempts} attempt(s))", flush=True)
@@ -279,7 +280,7 @@ def run_session(wanted, steps=None, retries=1, backoff_s=10.0,
                 os.remove(_checkpoint_path(state_dir, name))
             except OSError:
                 pass
-    t0 = time.time()
+    t0 = time.monotonic()
     outcomes = []
     tunnel_dead = None   # reason string once the probe proves unhealthy
     for name, cmd, bound, extra_env in steps:
@@ -334,7 +335,7 @@ def run_session(wanted, steps=None, retries=1, backoff_s=10.0,
         counts[e["outcome"]] = counts.get(e["outcome"], 0) + 1
     session = {
         "session": {
-            "wall_s": round(time.time() - t0, 1),
+            "wall_s": round(time.monotonic() - t0, 1),
             "steps_wanted": len(wanted),
             "outcomes": counts,
             "tunnel_dead": tunnel_dead,

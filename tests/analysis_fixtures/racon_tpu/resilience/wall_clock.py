@@ -1,0 +1,11 @@
+"""Fixture: wall-clock — time.time() deadline in the resilience layer."""
+
+import time
+
+
+def wait_until_done(poll):
+    deadline = time.time() + 30.0
+    while time.time() < deadline:
+        if poll():
+            return True
+    return False

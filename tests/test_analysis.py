@@ -28,6 +28,7 @@ FIXTURES = {
     "env-registry": "racon_tpu/ops/env_read.py",
     "fault-point": "racon_tpu/ops/bad_fault_point.py",
     "device-except": "racon_tpu/ops/broad_except.py",
+    "wall-clock": "racon_tpu/resilience/wall_clock.py",
 }
 
 #: per-file rules (knob-docs is project-level; covered separately)

@@ -77,4 +77,36 @@ else
     run "native lint" make -C racon_tpu/native lint
 fi
 
+# 5. Sanitizer matrix: instrumented native builds + the rt_stress race
+#    harness under TSan/ASan/UBSan.  Each mode is probed by compiling a
+#    trivial program first — a toolchain without that sanitizer runtime
+#    (common on minimal images) skips with a notice instead of failing.
+san_probe() {  # san_probe <flag>  -> 0 when the toolchain supports it
+    probe_dir=$(mktemp -d) || return 1
+    printf 'int main(void){return 0;}\n' > "$probe_dir/probe.c"
+    ${CXX:-g++} "$1" "$probe_dir/probe.c" -o "$probe_dir/probe" \
+        >/dev/null 2>&1
+    rc=$?
+    rm -rf "$probe_dir"
+    return $rc
+}
+
+if [ "$fast" = "--fast" ]; then
+    skip "sanitizers (asan/tsan/ubsan)" "--fast"
+else
+    for mode in asan tsan ubsan; do
+        case $mode in
+            asan)  flag=-fsanitize=address ;;
+            tsan)  flag=-fsanitize=thread ;;
+            ubsan) flag=-fsanitize=undefined ;;
+        esac
+        if san_probe "$flag"; then
+            run "native $mode (rt_test + rt_stress)" \
+                make -C racon_tpu/native "$mode"
+        else
+            skip "native $mode" "toolchain lacks $flag"
+        fi
+    done
+fi
+
 exit $fail
