@@ -297,6 +297,20 @@ def log_device_measurement(entry: dict) -> None:
               f"{e}", file=sys.stderr)
 
 
+def phase_wall(report_summary) -> dict:
+    """Per-phase wall seconds (summed over serving tiers) from a
+    RunReport.summary() dict — the bench's compact phase breakdown.
+    Entries without per-tier walls (pre-observability writers) yield
+    {}."""
+    out = {}
+    if isinstance(report_summary, dict):
+        for phase, rep in report_summary.items():
+            if isinstance(rep, dict) and isinstance(rep.get("wall_s"),
+                                                    dict):
+                out[phase] = round(sum(rep["wall_s"].values()), 4)
+    return out
+
+
 def normalize_entry(e: dict) -> dict:
     """Reader-side honesty backfill for bench JSON entries/log lines.
 
@@ -306,7 +320,11 @@ def normalize_entry(e: dict) -> dict:
     ``vs_baseline: null`` plus ``device_status: "unreachable"``; this
     helper lifts old entries to the same semantics so both generations
     parse identically downstream.  A measured 0.0 (device reachable,
-    ratio genuinely zero) is left untouched."""
+    ratio genuinely zero) is left untouched.
+
+    Also backfills ``phase_wall`` (per-phase wall seconds) for entries
+    whose embedded report already carried per-tier walls but predate the
+    explicit stamp."""
     if not isinstance(e, dict):
         return e
     unreachable = (e.get("device_status") == "unreachable"
@@ -315,6 +333,10 @@ def normalize_entry(e: dict) -> dict:
         e = dict(e, device_status="unreachable")
         if e.get("vs_baseline") == 0.0:
             e["vs_baseline"] = None
+    if "phase_wall" not in e:
+        pw = phase_wall(e.get("report"))
+        if pw:
+            e = dict(e, phase_wall=pw)
     return e
 
 
@@ -482,7 +504,7 @@ def main():
         "aligner": _aligner_log_value(aligner),
         "node_factor": config.get_int("RACON_TPU_NODE_FACTOR"),
         "tpu_s": round(dt_tpu, 1), "cpu_s": round(dt_cpu, 1),
-        "report": rep_tpu,
+        "report": rep_tpu, "phase_wall": phase_wall(rep_tpu),
         **({"sanitize": True} if sanitized else {}),
     })
     print(json.dumps({
@@ -491,7 +513,7 @@ def main():
         "value": round(mbps_tpu, 4),
         "unit": "Mbp/s",
         "vs_baseline": round(mbps_tpu / mbps_cpu, 3),
-        "report": rep_tpu,
+        "report": rep_tpu, "phase_wall": phase_wall(rep_tpu),
         **({"sanitize": True} if sanitized else {}),
     }))
     print(f"[bench] tpu: {bp_tpu} bp in {dt_tpu:.1f}s | "

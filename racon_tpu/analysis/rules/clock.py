@@ -6,7 +6,10 @@ minutes mid-run.  The resilience layer (watchdog timeouts, retry
 backoff, run reports) and the hardware-session driver (per-step
 budgets, lease renewal) are exactly the code that must survive such
 steps, so they use ``time.monotonic()`` (or ``time.perf_counter`` for
-fine-grained spans) exclusively.  Wall-clock reads are fine elsewhere —
+fine-grained spans) exclusively.  The observability tracer is scoped for
+the same reason: span durations computed from a stepped wall clock show
+up as negative/garbage bars in Perfetto.  Wall-clock reads are fine
+elsewhere —
 log timestamps, unique directory names — hence the narrow scope.
 """
 
@@ -18,15 +21,18 @@ from typing import Iterable
 from ..lint import FileContext, Violation
 from . import dotted_name
 
-#: Scope: the resilience package plus the hw-session driver.
-_SCOPED = (("resilience",),)
+#: Scope: the resilience package, the observability layer (trace spans
+#: must be monotonic or Perfetto renders negative durations), and the
+#: hw-session driver.
+_SCOPED = (("resilience",), ("obs",))
 _SCOPED_FILES = ("racon_tpu/tools/hw_session.py",)
 
 
 class WallClockRule:
     id = "wall-clock"
-    doc = ("no time.time() in racon_tpu/resilience/ or tools/hw_session.py; "
-           "deadlines and elapsed-time math use time.monotonic()")
+    doc = ("no time.time() in racon_tpu/resilience/, racon_tpu/obs/, or "
+           "tools/hw_session.py; deadlines, elapsed-time math, and trace "
+           "spans use time.monotonic()")
 
     def check(self, ctx: FileContext) -> Iterable[Violation]:
         if not (any(ctx.in_package(*p) for p in _SCOPED)

@@ -59,6 +59,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="write a machine-readable JSON run report (per-phase "
                    "serving tiers, fallback causes, retries, quarantined "
                    "windows, wall time per tier) to PATH")
+    p.add_argument("--trace", metavar="PATH", default=None,
+                   help="write a Chrome-trace/Perfetto JSON timeline of the "
+                   "run (phase spans, per-bucket POA batches, lattice "
+                   "events, kernel builds, embedded metrics snapshot) to "
+                   "PATH; inspect with `python -m racon_tpu.obs PATH` or "
+                   "ui.perfetto.dev (env: RACON_TPU_TRACE)")
     jr = p.add_mutually_exclusive_group()
     jr.add_argument("--journal", metavar="PATH", default=None,
                     help="append every served window/CIGAR to a crash-safe "
@@ -123,7 +129,8 @@ def main(argv=None) -> int:
             match=args.match, mismatch=args.mismatch, gap=args.gap,
             num_threads=args.threads,
             journal_path=args.resume_journal or args.journal,
-            resume_journal=args.resume_journal is not None)
+            resume_journal=args.resume_journal is not None,
+            trace_path=args.trace)
         polisher.initialize()
         for name, data in polisher.polish(not args.include_unpolished):
             sys.stdout.write(f">{name}\n{data}\n")

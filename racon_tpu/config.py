@@ -95,6 +95,17 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
     _k("RACON_TPU_SANITIZE_PARITY", "8", "int",
        "sanitize mode: host-recompute and byte-compare every Nth "
        "device-served window (0 disables the parity probe)"),
+    # -- observability knobs ----------------------------------------------
+    _k("RACON_TPU_TRACE", None, "str",
+       "write a Chrome-trace/Perfetto JSON span timeline of every polish "
+       "to this path (CLI --trace overrides; see racon_tpu/obs)"),
+    _k("RACON_TPU_METRICS", None, "bool",
+       "collect the in-process metrics registry (per-tier counters + "
+       "histograms) and embed a snapshot in the run report even without "
+       "a trace file"),
+    _k("RACON_TPU_TRACE_DEVICE", None, "bool",
+       "with tracing armed on a real TPU backend, also capture a "
+       "jax.profiler device trace next to the trace file"),
     # -- test / bench knobs ----------------------------------------------
     _k("RACON_TPU_HW_TESTS", None, "bool",
        "assert exact on-hardware pins against a real TPU backend",

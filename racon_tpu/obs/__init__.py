@@ -1,0 +1,220 @@
+"""Unified observability: span tracing + metrics for the polish pipeline.
+
+One module-level armed/disarmed switch feeds two sinks:
+
+* a **span tracer** (tracer.Tracer) producing Chrome-trace/Perfetto JSON
+  — nested phase spans, per-bucket POA batches, align cohorts, journal
+  replays, kernel builds, plus instant events for lattice retries /
+  demotions / quarantines and watchdog timeouts;
+* a **metrics registry** (metrics.Metrics) — counters and histograms
+  keyed by phase, serving tier, and bucket class.  ``served.*`` counters
+  are incremented inside ``PhaseReport.record_served`` itself, so the
+  served-sum invariant between the metrics and the run report is checked
+  (``served_sum_check``), not assumed.
+
+Arming: ``obs.configure(trace_path=...)`` (the polisher constructors call
+it after ``obs.reset()``), the CLI ``--trace`` flag, or the
+``RACON_TPU_TRACE`` / ``RACON_TPU_METRICS`` knobs.  Disarmed, every hook
+is a no-op: ``span()`` returns a shared null singleton and ``count()`` /
+``event()`` are a None-check — polish output stays byte-identical and no
+trace file is written (regression-tested in tests/test_obs.py).
+
+Imports stay stdlib + config so this module is loadable from anywhere in
+the stack (kernel_cache, resilience, tools) without cycles or a jax
+dependency; the optional ``jax.profiler`` device capture imports jax
+lazily and only when armed on a TPU backend.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Optional
+
+from .. import config
+from .metrics import Metrics
+from .tracer import NULL_SPAN, Span, Tracer
+
+ENV_TRACE = "RACON_TPU_TRACE"
+ENV_METRICS = "RACON_TPU_METRICS"
+ENV_TRACE_DEVICE = "RACON_TPU_TRACE_DEVICE"
+
+#: The five pipeline phases every polish decomposes into, in execution
+#: order.  Span names are ``phase.<name>``; the CLI breakdown and the
+#: CI trace validation key off this tuple.
+PHASES = ("parse", "align", "window_assign", "poa", "stitch")
+
+_lock = threading.Lock()
+_tracer: Optional[Tracer] = None
+_metrics: Optional[Metrics] = None
+_trace_path: Optional[str] = None
+_device_tracing = False
+
+
+# -- arming ----------------------------------------------------------------
+
+def reset() -> None:
+    """Disarm and drop all collected state (called per run by the
+    polisher constructors, before ``configure``).  A device trace left
+    running by a crashed run is stopped first."""
+    global _tracer, _metrics, _trace_path
+    maybe_stop_device_trace()
+    with _lock:
+        _tracer = None
+        _metrics = None
+        _trace_path = None
+
+
+def configure(trace_path: Optional[str] = None,
+              metrics: Optional[bool] = None) -> None:
+    """Arm for one run.  Explicit arguments (the CLI flags) win; ``None``
+    falls back to the ``RACON_TPU_TRACE`` / ``RACON_TPU_METRICS`` knobs.
+    Tracing implies metrics (the snapshot rides inside the trace file);
+    ``RACON_TPU_METRICS=1`` alone collects spans + counters in memory for
+    the ``RunReport["obs"]`` snapshot without writing a trace file."""
+    global _tracer, _metrics, _trace_path
+    if trace_path is None:
+        trace_path = config.get_str(ENV_TRACE) or None
+    if metrics is None:
+        metrics = config.get_bool(ENV_METRICS)
+    if not trace_path and not metrics:
+        return
+    with _lock:
+        _trace_path = trace_path
+        _tracer = Tracer()
+        _metrics = Metrics()
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def trace_path() -> Optional[str]:
+    return _trace_path
+
+
+# -- recording hooks (each a cheap no-op when disarmed) --------------------
+
+def span(name: str, **args):
+    """Context manager timing a region; returns the shared null span
+    when disarmed so the call site costs one identity return."""
+    t = _tracer
+    if t is None:
+        return NULL_SPAN
+    return Span(t, name, args)
+
+
+def event(name: str, **args) -> None:
+    """Instant event (lattice demotion, watchdog timeout, …)."""
+    t = _tracer
+    if t is not None:
+        t.add_instant(name, **args)
+
+
+def add_complete(name: str, t0_ns: int, t1_ns: int, **args) -> None:
+    """Retroactive span from raw monotonic_ns stamps (kernel-cache miss
+    detection times the call first, then learns it was a compile)."""
+    t = _tracer
+    if t is not None:
+        t.add_complete(name, t0_ns, t1_ns, **args)
+
+
+def count(name: str, n: int = 1) -> None:
+    m = _metrics
+    if m is not None:
+        m.count(name, n)
+
+
+def observe(name: str, value: float) -> None:
+    m = _metrics
+    if m is not None:
+        m.observe(name, value)
+
+
+# -- snapshots & invariants ------------------------------------------------
+
+def snapshot() -> Optional[dict]:
+    """JSON-ready metrics snapshot, or None when disarmed."""
+    m = _metrics
+    return None if m is None else m.snapshot()
+
+
+def served_sum_check(phases) -> dict:
+    """Cross-check the ``served.<phase>.<tier>`` counters against each
+    ``PhaseReport``'s served totals.  The counters are fed from
+    ``record_served`` itself, so a mismatch means some code path served
+    work while bypassing the report (or vice versa) — exactly the drift
+    this layer exists to catch.
+
+    ``phases`` is the ``RunReport.phases`` mapping; returns
+    ``{phase: {"report": n, "metrics": n, "ok": bool}}``."""
+    m = _metrics
+    if m is None:
+        return {}
+    out = {}
+    for name, rep in phases.items():
+        counted = m.prefix_sum(f"served.{name}.")
+        total = rep.served_total()
+        out[name] = {"report": total, "metrics": counted,
+                     "ok": counted == total}
+    return out
+
+
+# -- export ----------------------------------------------------------------
+
+def write_trace() -> Optional[str]:
+    """Write the Chrome-trace JSON (metrics snapshot embedded) to the
+    configured path.  Returns the path written, or None when tracing is
+    disarmed or armed metrics-only.  A write failure warns — a full disk
+    must not fail the polish that just finished."""
+    t, path = _tracer, _trace_path
+    if t is None or not path:
+        return None
+    try:
+        t.write(path, metrics=snapshot())
+    except OSError as e:
+        print(f"[racon_tpu::obs] WARNING: cannot write trace {path}: {e}",
+              file=sys.stderr)
+        return None
+    return path
+
+
+# -- optional jax.profiler device capture ----------------------------------
+
+def maybe_start_device_trace() -> bool:
+    """Best-effort ``jax.profiler`` device trace next to the host trace
+    (``<trace_path>.device/``), gated on ``RACON_TPU_TRACE_DEVICE=1`` and
+    an actual TPU backend — on CPU/GPU the host spans already tell the
+    whole story.  Any failure degrades to host-only tracing."""
+    global _device_tracing
+    if _trace_path is None or _device_tracing:
+        return False
+    if not config.get_bool(ENV_TRACE_DEVICE):
+        return False
+    try:
+        import jax
+
+        if jax.devices()[0].platform != "tpu":
+            return False
+        jax.profiler.start_trace(f"{_trace_path}.device")
+    except Exception as e:  # noqa: BLE001 — never fail a polish for this
+        print(f"[racon_tpu::obs] WARNING: device trace unavailable "
+              f"({type(e).__name__}: {e}); continuing host-only",
+              file=sys.stderr)
+        return False
+    _device_tracing = True
+    return True
+
+
+def maybe_stop_device_trace() -> None:
+    global _device_tracing
+    if not _device_tracing:
+        return
+    _device_tracing = False
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception as e:  # noqa: BLE001
+        print(f"[racon_tpu::obs] WARNING: device trace stop failed "
+              f"({type(e).__name__}: {e})", file=sys.stderr)

@@ -1,0 +1,225 @@
+"""``python -m racon_tpu.obs`` — read a trace written via ``--trace`` /
+``RACON_TPU_TRACE``: validate the Chrome-trace schema, render a
+phase/tier breakdown, or diff two runs.
+
+Exit codes (CI keys off these):
+
+* 0 — trace valid (and, in ``--diff`` mode, no regression)
+* 1 — schema violation(s) in an otherwise readable trace
+* 2 — file unreadable / not JSON / not a trace object
+* 3 — ``--diff`` found a phase regression past ``--threshold``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Tuple
+
+from . import PHASES
+
+_VALID_PH = {"X", "B", "E", "i", "I", "M", "C"}
+
+
+def load_trace(path: str) -> Tuple[dict, List[str]]:
+    """Read + structurally validate one trace file.  Returns the parsed
+    document and a list of schema-violation strings (empty = valid).
+    Raises OSError/ValueError for exit-code-2 conditions."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError("not a Chrome-trace object (no 'traceEvents' key)")
+    errors: List[str] = []
+    events = doc["traceEvents"]
+    if not isinstance(events, list):
+        return doc, ["'traceEvents' is not a list"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _VALID_PH:
+            errors.append(f"{where}: bad or missing 'ph' {ph!r}")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            errors.append(f"{where}: bad or missing 'name'")
+        if not isinstance(ev.get("pid"), int) \
+                or not isinstance(ev.get("tid"), int):
+            errors.append(f"{where}: bad or missing 'pid'/'tid'")
+        if ph == "M":
+            continue  # metadata events carry no timestamp
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            errors.append(f"{where}: bad or missing 'ts' {ts!r}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f"{where}: complete event with bad "
+                              f"'dur' {dur!r}")
+        if len(errors) >= 50:
+            errors.append("... (further violations suppressed)")
+            break
+    return doc, errors
+
+
+def phase_walls_us(doc: dict) -> Dict[str, int]:
+    """Total duration per ``phase.*`` span, µs."""
+    walls: Dict[str, int] = {}
+    for ev in doc.get("traceEvents", []):
+        if isinstance(ev, dict) and ev.get("ph") == "X" \
+                and isinstance(ev.get("name"), str) \
+                and ev["name"].startswith("phase."):
+            name = ev["name"][len("phase."):]
+            walls[name] = walls.get(name, 0) + int(ev.get("dur", 0))
+    return walls
+
+
+def _counters(doc: dict) -> Dict[str, int]:
+    m = doc.get("racon_tpu")
+    if isinstance(m, dict):
+        m = m.get("metrics")
+    if isinstance(m, dict):
+        c = m.get("counters")
+        if isinstance(c, dict):
+            return c
+    return {}
+
+
+def breakdown(doc: dict) -> dict:
+    """Phase walls, per-tier served counters, and event counts — the
+    machine-readable form behind the rendered table."""
+    walls = phase_walls_us(doc)
+    counters = _counters(doc)
+    served: Dict[str, Dict[str, int]] = {}
+    for name, v in counters.items():
+        parts = name.split(".")
+        if len(parts) == 3 and parts[0] == "served":
+            served.setdefault(parts[1], {})[parts[2]] = v
+    events: Dict[str, int] = {}
+    for ev in doc.get("traceEvents", []):
+        if isinstance(ev, dict) and ev.get("ph") == "i":
+            events[ev.get("name", "?")] = events.get(ev.get("name", "?"),
+                                                     0) + 1
+    return {"phase_us": walls, "served": served, "events": events,
+            "counters": counters}
+
+
+def render(doc: dict, path: str) -> str:
+    b = breakdown(doc)
+    lines = [f"trace: {path}"]
+    total = sum(b["phase_us"].values())
+    lines.append("-- phases " + "-" * 34)
+    order = [p for p in PHASES if p in b["phase_us"]]
+    order += sorted(set(b["phase_us"]) - set(order))
+    for p in order:
+        us = b["phase_us"][p]
+        pct = (100.0 * us / total) if total else 0.0
+        lines.append(f"  {p:<16s} {us / 1e3:>10.2f} ms {pct:>5.1f}%")
+    if not order:
+        lines.append("  (no phase.* spans)")
+    if b["served"]:
+        lines.append("-- served (windows/jobs per tier) " + "-" * 10)
+        for phase, tiers in sorted(b["served"].items()):
+            mix = "  ".join(f"{t}={n}" for t, n in sorted(tiers.items()))
+            lines.append(f"  {phase:<16s} {mix}  (sum="
+                         f"{sum(tiers.values())})")
+    if b["events"]:
+        lines.append("-- events " + "-" * 34)
+        for name, n in sorted(b["events"].items()):
+            lines.append(f"  {name:<28s} x{n}")
+    return "\n".join(lines)
+
+
+def diff(old: dict, new: dict, threshold: float,
+         min_delta_us: int) -> List[str]:
+    """Phase-wall regressions: new > old*(1+threshold) and the absolute
+    growth exceeds ``min_delta_us`` (filters noise on tiny runs)."""
+    ow, nw = phase_walls_us(old), phase_walls_us(new)
+    regressions = []
+    for phase in sorted(set(ow) | set(nw)):
+        o, n = ow.get(phase, 0), nw.get(phase, 0)
+        if n > o * (1.0 + threshold) and (n - o) > min_delta_us:
+            pct = (100.0 * (n - o) / o) if o else float("inf")
+            regressions.append(
+                f"phase.{phase}: {o / 1e3:.2f} ms -> {n / 1e3:.2f} ms "
+                f"(+{pct:.0f}%, threshold {threshold * 100:.0f}%)")
+    return regressions
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m racon_tpu.obs",
+        description="validate / summarize / diff racon_tpu trace files "
+                    "(Chrome-trace JSON from --trace / RACON_TPU_TRACE)")
+    p.add_argument("trace", nargs="+",
+                   help="trace file (two files with --diff: OLD NEW)")
+    p.add_argument("--validate", action="store_true",
+                   help="schema validation only, no breakdown")
+    p.add_argument("--diff", action="store_true",
+                   help="compare two traces; exit 3 on phase regression")
+    p.add_argument("--threshold", type=float, default=0.25,
+                   help="--diff: relative slowdown tolerated per phase "
+                        "(default 0.25 = 25%%)")
+    p.add_argument("--min-delta-us", type=int, default=1000,
+                   help="--diff: ignore regressions smaller than this "
+                        "many µs (default 1000)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    args = p.parse_args(argv)
+
+    if args.diff and len(args.trace) != 2:
+        print("[obs] --diff needs exactly two trace files", file=sys.stderr)
+        return 2
+    if not args.diff and len(args.trace) != 1:
+        print("[obs] expected one trace file (or two with --diff)",
+              file=sys.stderr)
+        return 2
+
+    docs = []
+    for path in args.trace:
+        try:
+            doc, errors = load_trace(path)
+        except (OSError, ValueError) as e:
+            print(f"[obs] cannot read trace {path}: {e}", file=sys.stderr)
+            return 2
+        if errors:
+            for err in errors:
+                print(f"[obs] {path}: {err}", file=sys.stderr)
+            print(f"[obs] SCHEMA FAIL: {path}: {len(errors)} violation(s)",
+                  file=sys.stderr)
+            return 1
+        docs.append(doc)
+
+    if args.diff:
+        regressions = diff(docs[0], docs[1], args.threshold,
+                           args.min_delta_us)
+        if args.as_json:
+            print(json.dumps({"regressions": regressions}, indent=2))
+        else:
+            for r in regressions:
+                print(f"[obs] REGRESSION: {r}")
+            if not regressions:
+                print(f"[obs] OK: no phase regression past "
+                      f"{args.threshold * 100:.0f}%")
+        return 3 if regressions else 0
+
+    doc = docs[0]
+    if args.validate:
+        if not args.as_json:
+            print(f"[obs] OK: {args.trace[0]} is valid Chrome-trace JSON "
+                  f"({len(doc['traceEvents'])} events)")
+        else:
+            print(json.dumps({"valid": True,
+                              "events": len(doc["traceEvents"])}))
+        return 0
+    if args.as_json:
+        print(json.dumps(breakdown(doc), indent=2))
+    else:
+        print(render(doc, args.trace[0]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,157 @@
+"""Thread-safe span tracer emitting Chrome-trace ("Trace Event Format")
+JSON, loadable in Perfetto / chrome://tracing.
+
+Design constraints (mirrored by tests/test_obs.py):
+
+* **Monotonic clock only.**  Span math uses ``time.monotonic_ns()``;
+  a wall-clock (``time.time``) span goes negative across an NTP step.
+  The ``wall-clock`` lint rule (analysis/rules/clock.py) scopes this
+  package, so a regression is a lint failure, not a code review hope.
+* **Bounded memory.**  The event buffer is capped; past the cap events
+  are counted as dropped (surfaced in the written trace) instead of
+  growing without bound on pathological runs.
+* **No data dependence.**  The tracer observes timing only — it never
+  touches sequences, CIGARs, or consensus bytes, which is what makes
+  the armed-vs-disarmed byte-identity guarantee trivial to keep.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+
+class Span:
+    """One timed region, used as a context manager.
+
+    Records a Chrome-trace complete ("ph":"X") event on exit; ``set()``
+    attaches key/value args that show up in the Perfetto detail pane.
+    An exception escaping the body is recorded as an ``error`` arg so a
+    trace of a degraded run shows *where* the lattice demoted."""
+
+    __slots__ = ("_tracer", "name", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args: dict):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+        self._t0 = 0
+
+    def set(self, **attrs) -> "Span":
+        self.args.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        self._t0 = time.monotonic_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None:
+            self.args.setdefault("error", exc_type.__name__)
+        self._tracer.add_complete(self.name, self._t0, time.monotonic_ns(),
+                                  **self.args)
+        return False
+
+
+class _NullSpan:
+    """The disarmed span: a shared, allocation-free no-op so tracing-off
+    call sites cost one attribute load + identity return."""
+
+    __slots__ = ()
+
+    def set(self, **attrs) -> "_NullSpan":
+        return self
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+#: Singleton handed out by ``obs.span()`` when tracing is disarmed.
+NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """In-memory trace-event buffer.  All mutation happens under one
+    lock, so spans opened from watchdog threads, the native callback
+    thread, or test thread pools interleave safely."""
+
+    def __init__(self, max_events: int = 200_000):
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._thread_names = {}   # tid -> python thread name ("M" events)
+        self.dropped = 0
+        self._max = max_events
+        # Event timestamps are offsets from tracer creation so traces
+        # start near ts=0 regardless of the monotonic clock's epoch.
+        self._t0 = time.monotonic_ns()
+        self.pid = os.getpid()
+
+    def _ts_us(self, t_ns: int) -> int:
+        return (t_ns - self._t0) // 1000
+
+    def _append(self, ev: dict) -> None:
+        tid = threading.get_ident()
+        ev["pid"] = self.pid
+        ev["tid"] = tid
+        with self._lock:
+            if tid not in self._thread_names:
+                self._thread_names[tid] = threading.current_thread().name
+            if len(self._events) >= self._max:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+
+    def add_complete(self, name: str, t0_ns: int, t1_ns: int,
+                     cat: str = "span", **args) -> None:
+        """Record a finished region [t0_ns, t1_ns] (monotonic_ns stamps).
+        Exposed directly (not only via Span) so call sites that detect an
+        interesting region *after the fact* — e.g. a kernel-cache miss —
+        can stamp it retroactively."""
+        self._append({"name": name, "cat": cat, "ph": "X",
+                      "ts": self._ts_us(t0_ns),
+                      "dur": max(0, (t1_ns - t0_ns) // 1000),
+                      "args": args})
+
+    def add_instant(self, name: str, cat: str = "event", **args) -> None:
+        """Record a point event (lattice demotion, watchdog timeout, …)."""
+        self._append({"name": name, "cat": cat, "ph": "i", "s": "t",
+                      "ts": self._ts_us(time.monotonic_ns()),
+                      "args": args})
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def to_dict(self, metrics: Optional[dict] = None) -> dict:
+        """The full Chrome-trace JSON object.  Extra top-level keys are
+        ignored by Perfetto, so the metrics snapshot and provenance ride
+        along in the same file the timeline lives in."""
+        with self._lock:
+            events = list(self._events)
+            names = dict(self._thread_names)
+            dropped = self.dropped
+        for tid, tname in sorted(names.items()):
+            events.append({"name": "thread_name", "ph": "M", "pid": self.pid,
+                           "tid": tid, "args": {"name": tname}})
+        doc = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"tool": "racon_tpu.obs", "clock": "monotonic",
+                          "dropped_events": dropped},
+        }
+        if metrics is not None:
+            doc["racon_tpu"] = {"metrics": metrics}
+        return doc
+
+    def write(self, path: str, metrics: Optional[dict] = None) -> None:
+        tmp = f"{path}.tmp.{self.pid}"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(metrics), f)
+            f.write("\n")
+        os.replace(tmp, path)

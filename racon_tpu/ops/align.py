@@ -132,7 +132,8 @@ def build_align_kernel(cap: int, band: int):
     return jax.jit(jax.vmap(one))
 
 
-def run_jobs(pipeline, jobs, batch: int = 16, report=None) -> int:
+def run_jobs(pipeline, jobs, batch: int = 16, report=None,
+             stats=None) -> int:
     """Align the given pipeline jobs on device; install CIGARs.
     Returns how many alignments the device served.
 
@@ -141,12 +142,19 @@ def run_jobs(pipeline, jobs, batch: int = 16, report=None) -> int:
     the degradation lattice: bounded retry, then bisection so a poisoned
     job is quarantined to the host while the rest of the chunk stays on
     the device.  A chunk-independent failure stops the engine; the served
-    count stays accurate for whatever was already installed."""
+    count stays accurate for whatever was already installed.
+
+    ``stats`` (the driver's accounting dict) has its ``'device'`` entry
+    incremented per installed CIGAR, so even an exception that escapes
+    this function entirely — a kernel build for a later bucket, a
+    sanitizer trip, an install failure — cannot zero out work already
+    installed (which the driver's host count is derived from)."""
     import sys
 
     from ..analysis import sanitize
     from ..resilience import faults
     from ..resilience import lattice as rl
+    from .. import obs
 
     served = 0
     if hasattr(pipeline, "align_job_lengths"):
@@ -165,6 +173,7 @@ def run_jobs(pipeline, jobs, batch: int = 16, report=None) -> int:
 
     for (cap, band), items in sorted(grouped.items()):
         kernel = build_align_kernel(cap, band)
+        obs.count(f"align.bucket.c{cap}", len(items))
         for off in range(0, len(items), batch):
             chunk = items[off:off + batch]
 
@@ -184,8 +193,10 @@ def run_jobs(pipeline, jobs, batch: int = 16, report=None) -> int:
                 return tuple(np.asarray(x) for x in _kernel(q, t, n, m))
 
             try:
-                pairs_results, quarantined = rl.serve_with_bisect(
-                    chunk, attempt, tier="xla", report=report)
+                with obs.span("align.cohort", tier="xla", cap=cap,
+                              jobs=len(chunk)):
+                    pairs_results, quarantined = rl.serve_with_bisect(
+                        chunk, attempt, tier="xla", report=report)
                 for sub, (ops, cnt, ok) in pairs_results:
                     if sanitize.enabled():
                         sanitize.check_align_outputs(
@@ -193,9 +204,12 @@ def run_jobs(pipeline, jobs, batch: int = 16, report=None) -> int:
                     for bi, job in enumerate(sub):
                         if not ok[bi]:
                             continue  # host will align it
+                        faults.check("align.install", (job,))
                         cigar = ops_to_cigar(ops[bi, :cnt[bi]][::-1])
                         pipeline.set_job_cigar(job, cigar)
                         served += 1
+                        if stats is not None:
+                            stats["device"] = stats.get("device", 0) + 1
                         if report is not None:
                             report.record_served("xla")
                 for job, exc in quarantined:

@@ -10,7 +10,7 @@ from __future__ import annotations
 import sys
 import time
 
-from .. import config
+from .. import config, obs
 
 
 def _on_tpu() -> bool:
@@ -98,8 +98,13 @@ def run_alignment_phase(pipeline, progress: bool = False,
                 if jobs:
                     sink = (CigarTap(pipeline, journal, "hirschberg")
                             if journal is not None else pipeline)
-                    stats["device"] = align_pallas.run_jobs(
-                        sink, jobs, report=report)
+                    # stats["device"] accumulates INSIDE run_jobs, per
+                    # installed CIGAR: an exception escaping run_jobs
+                    # after partial installs (kernel build, sanitizer,
+                    # install failure) must not zero the device count —
+                    # the host-served figure below is derived from it.
+                    align_pallas.run_jobs(sink, jobs, report=report,
+                                          stats=stats)
             else:
                 faults.check("align.compile")
                 from . import align
@@ -111,8 +116,7 @@ def run_alignment_phase(pipeline, progress: bool = False,
                 if jobs:
                     sink = (CigarTap(pipeline, journal, "xla")
                             if journal is not None else pipeline)
-                    stats["device"] = align.run_jobs(
-                        sink, jobs, report=report)
+                    align.run_jobs(sink, jobs, report=report, stats=stats)
         except Exception as e:  # noqa: BLE001 — engine/backend init
             print(f"[racon_tpu::align] WARNING: device aligner "
                   f"'{engine}' failed ({type(e).__name__}: {e}); "
@@ -123,7 +127,9 @@ def run_alignment_phase(pipeline, progress: bool = False,
     # Host finishes everything still CIGAR-less (device-rejected or
     # ineligible).
     t0 = time.perf_counter()
-    pipeline.align_jobs_cpu()
+    with obs.span("align.host") as sp:
+        pipeline.align_jobs_cpu()
+        sp.set(jobs=n - stats["device"] - len(replayed))
     report.add_wall("host", time.perf_counter() - t0)
     stats["host"] = n - stats["device"] - len(replayed)
     report.record_served("host", stats["host"])

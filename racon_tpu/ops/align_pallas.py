@@ -541,7 +541,8 @@ def cohort_size(default: int = 64) -> int:
     return max(1, int(env if env is not None else default))
 
 
-def run_jobs(pipeline, jobs, cohort: int = None, report=None) -> int:
+def run_jobs(pipeline, jobs, cohort: int = None, report=None,
+             stats=None) -> int:
     """Align pipeline jobs with the Hirschberg engine; install CIGARs.
     Returns how many the device served (band escapes fall to host).
     Jobs are materialized per cohort so host memory stays O(cohort), not
@@ -552,11 +553,15 @@ def run_jobs(pipeline, jobs, cohort: int = None, report=None) -> int:
     of the cohort stays on the device).  A cohort-independent failure
     stops the engine and leaves the remaining jobs CIGAR-less for the
     host — the served count stays accurate for the cohorts already
-    installed, whatever point the engine died at."""
+    installed, whatever point the engine died at.  ``stats['device']``
+    (when the driver passes its accounting dict) is incremented per
+    install, so even an exception escaping this function cannot erase
+    already-installed work from the driver's device count."""
     import sys
 
     from ..resilience import faults
     from ..resilience import lattice as rl
+    from .. import obs
 
     if cohort is None:
         cohort = cohort_size()
@@ -574,14 +579,19 @@ def run_jobs(pipeline, jobs, cohort: int = None, report=None) -> int:
             return align_pairs(pairs)
 
         try:
-            pairs_results, quarantined = rl.serve_with_bisect(
-                group, attempt, tier="hirschberg", report=report)
+            with obs.span("align.cohort", tier="hirschberg",
+                          jobs=len(group)):
+                pairs_results, quarantined = rl.serve_with_bisect(
+                    group, attempt, tier="hirschberg", report=report)
             for sub, results in pairs_results:
                 for job, ops in zip(sub, results):
                     if ops is None:
                         continue  # band escape: host aligns it
+                    faults.check("align.install", (job,))
                     pipeline.set_job_cigar(job, ops_to_cigar(ops))
                     served += 1
+                    if stats is not None:
+                        stats["device"] = stats.get("device", 0) + 1
                     if report is not None:
                         report.record_served("hirschberg")
             for job, exc in quarantined:

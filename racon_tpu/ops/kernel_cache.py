@@ -16,6 +16,9 @@ every cached kernel builder either uses this decorator or takes explicit
 from __future__ import annotations
 
 import functools
+import time
+
+from .. import obs
 
 
 def device_keyed_cache(maxsize: int = 64):
@@ -36,7 +39,19 @@ def device_keyed_cache(maxsize: int = 64):
             import jax
 
             devs = jax.devices()
+            # Kernel-(re)build observability: a cache miss here is the
+            # builder running (tracing + staging; the XLA compile proper
+            # lands in the first submit span).  The miss is only known
+            # after the call, so the span is stamped retroactively from
+            # monotonic stamps taken around it.
+            misses0 = cached.cache_info().misses
+            t0 = time.monotonic_ns()
             built = cached(len(devs), devs[0].platform, *args, **kwargs)
+            if cached.cache_info().misses != misses0:
+                obs.add_complete("kernel.build", t0, time.monotonic_ns(),
+                                 builder=build.__name__,
+                                 platform=devs[0].platform)
+                obs.count(f"kernel.builds.{build.__name__}")
             # Opt-in runtime sanitizer (RACON_TPU_SANITIZE=1): hand the
             # built kernel back wrapped in a checking proxy. Imported
             # lazily at call time — by the first kernel build the

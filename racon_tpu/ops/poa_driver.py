@@ -27,7 +27,7 @@ from typing import List
 
 import numpy as np
 
-from .. import config
+from .. import config, obs
 from ..resilience import faults
 from ..resilience import lattice as rl
 from ..resilience.journal import replay_windows
@@ -191,27 +191,29 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
 
     # Metadata pass: geometry + depth buckets, no layer bytes touched.
     jobs = []          # (window_idx, estimated depth, backbone len)
-    for i in range(n):
-        if i in replayed:
-            continue
-        n_seqs, bb_len, _rank, _is_tgs, _bytes, tid = pipeline.window_info(i)
-        k = n_seqs - 1
-        if k < 2:
-            # <3 sequences incl. backbone: backbone passthrough
-            # (reference: src/window.cpp:68-71)
-            try:
-                wx = pipeline.export_window(i)
-            except Exception as e:  # noqa: BLE001 — export seam
-                fallback.append(i)
-                report.record_quarantine(i, e)
+    with obs.span("poa.metadata", windows=n):
+        for i in range(n):
+            if i in replayed:
                 continue
-            pipeline.set_consensus(i, wx.backbone.tobytes(), False)
-            if journal is not None:
-                journal.append_window(i, tid, wx.rank, "backbone",
-                                      wx.backbone.tobytes(), False)
-            stats["backbone"] += 1
-            continue
-        jobs.append((i, min(k, DEPTH_CAP), bb_len))
+            (n_seqs, bb_len, _rank, _is_tgs, _bytes,
+             tid) = pipeline.window_info(i)
+            k = n_seqs - 1
+            if k < 2:
+                # <3 sequences incl. backbone: backbone passthrough
+                # (reference: src/window.cpp:68-71)
+                try:
+                    wx = pipeline.export_window(i)
+                except Exception as e:  # noqa: BLE001 — export seam
+                    fallback.append(i)
+                    report.record_quarantine(i, e)
+                    continue
+                pipeline.set_consensus(i, wx.backbone.tobytes(), False)
+                if journal is not None:
+                    journal.append_window(i, tid, wx.rank, "backbone",
+                                          wx.backbone.tobytes(), False)
+                stats["backbone"] += 1
+                continue
+            jobs.append((i, min(k, DEPTH_CAP), bb_len))
     report.record_served("backbone", stats["backbone"])
 
     if jobs:
@@ -225,6 +227,17 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
         # estimate chose; and short windows run in their own 128-grid
         # geometry class instead of the dataset-max geometry (one long
         # target in a mixed run used to inflate every bucket's DP ranges).
+        # Note the layer-admission shift that rides along with per-class
+        # geometry: a layer is admitted against ITS WINDOW'S class
+        # max_len (cfg.max_len = 2x the 128-ceiled backbone class), not
+        # the dataset-wide maximum — so a long stray layer over a short
+        # backbone is dropped at pack time where the old single-geometry
+        # driver would have admitted it.  Dropped layers only thin the
+        # POA coverage (consensus still forms; parity with the reference
+        # is kept by the golden tests); the count is surfaced as
+        # report.extra["layers_dropped_maxlen"] and the
+        # `poa.layers_dropped_maxlen` metrics counter so a serving-mix
+        # or accuracy shift on mixed-length datasets is attributable.
         buckets = {}
         for i, depth, bb in jobs:
             bucket = next(b for b in DEPTH_BUCKETS if depth <= b)
@@ -245,72 +258,87 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
         # warm-up proved dead
         dead_geoms = set(_WARM_DEAD)
         for (depth_bucket, wl_class), bucket_jobs in sorted(buckets.items()):
-            cfg = make_config(wl_class, depth_bucket, match, mismatch, gap)
-            # Large window geometries (e.g. -w 1000) overflow the fused
-            # kernel's VMEM budget; the entry tier is picked per geometry.
-            entry_kind = _pick_tier(cfg, use_pallas, requested)
-            # (Per-bucket depth is kept deliberately: the fused kernel's
-            # VMEM footprint is depth-independent now, but packing and
-            # host->device transfer scale with the padded depth — a single
-            # DEPTH_CAP geometry would ship ~25x zeros for the shallow
-            # buckets on every chunk to save compiles that the lru +
-            # persistent compilation caches already amortize.)
-            # Sequential loops run lock-step across the batch, so keep
-            # batches depth-homogeneous — and length-homogeneous within
-            # equal depth: a lockstep program's DP range is the union
-            # over its 8 windows, so mixing a short window into a long
-            # group bills it the long group's ranks.
-            bucket_jobs.sort(key=lambda job: (job[1], job[2]))
-            for off in range(0, len(bucket_jobs), B):
-                idxs = [i for i, _, _ in bucket_jobs[off:off + B]]
-                # best LIVE tier for this geometry (earlier chunks or the
-                # warm-up may have proven tiers dead)
-                kernel, kind = _live_tier(cfg, B, entry_kind, dead_geoms,
-                                          report)
-                if kind == "host":
-                    fallback.extend(idxs)
-                    continue
-                chunk = _export_chunk(pipeline, idxs, cfg, fallback,
-                                      stats, report)
-                if not chunk:
-                    continue
-                # Always pad to B: a dataset-size-dependent final-chunk
-                # shape would force an extra jit compile per distinct
-                # remainder (padded windows are 1-base/0-layer — free).
-                packed = _pack(chunk, cfg, B)
-                try:
-                    faults.check(f"poa.run.{kind}",
-                                 [i for i, _, _ in chunk])
-                    outs = _submit(kernel, packed, kind in _PALLAS_KINDS)
-                except Exception as e:  # noqa: BLE001 — lattice boundary
-                    # synchronous dispatch failure: resolve this chunk
-                    # through the lattice right now (retry/bisect/demote)
-                    report.record_failure(kind, e)
-                    report.retries += 1
-                    _resolve(pipeline, chunk, None, cfg, B, kind,
-                             dead_geoms, trim, stats, fallback, report,
-                             journal)
-                    continue
-                pending.append((chunk, packed, outs, cfg, kind))
-                if len(pending) >= q_depth:
-                    _drain(pipeline, pending.popleft(), trim, stats,
-                           fallback, B, dead_geoms, report, journal)
-            if progress:
-                print(f"[racon_tpu::poa] bucket depth<={depth_bucket} "
-                      f"len<={wl_class}: {len(bucket_jobs)} windows",
-                      file=sys.stderr)
+            obs.count(f"poa.windows.d{depth_bucket}.c{wl_class}",
+                      len(bucket_jobs))
+            obs.observe("poa.bucket_windows", len(bucket_jobs))
+            # Bucket spans cover submit-side work; with pipelining a
+            # chunk of bucket X may *drain* inside bucket Y's span — the
+            # async-dispatch overlap the trace is there to make visible.
+            with obs.span("poa.bucket", depth=depth_bucket,
+                          wl_class=wl_class, windows=len(bucket_jobs)):
+                cfg = make_config(wl_class, depth_bucket, match, mismatch,
+                                  gap)
+                # Large window geometries (e.g. -w 1000) overflow the fused
+                # kernel's VMEM budget; the entry tier is picked per
+                # geometry.
+                entry_kind = _pick_tier(cfg, use_pallas, requested)
+                # (Per-bucket depth is kept deliberately: the fused
+                # kernel's VMEM footprint is depth-independent now, but
+                # packing and host->device transfer scale with the padded
+                # depth — a single DEPTH_CAP geometry would ship ~25x
+                # zeros for the shallow buckets on every chunk to save
+                # compiles that the lru + persistent compilation caches
+                # already amortize.)
+                # Sequential loops run lock-step across the batch, so keep
+                # batches depth-homogeneous — and length-homogeneous
+                # within equal depth: a lockstep program's DP range is the
+                # union over its 8 windows, so mixing a short window into
+                # a long group bills it the long group's ranks.
+                bucket_jobs.sort(key=lambda job: (job[1], job[2]))
+                for off in range(0, len(bucket_jobs), B):
+                    idxs = [i for i, _, _ in bucket_jobs[off:off + B]]
+                    # best LIVE tier for this geometry (earlier chunks or
+                    # the warm-up may have proven tiers dead)
+                    kernel, kind = _live_tier(cfg, B, entry_kind,
+                                              dead_geoms, report)
+                    if kind == "host":
+                        fallback.extend(idxs)
+                        continue
+                    chunk = _export_chunk(pipeline, idxs, cfg, fallback,
+                                          stats, report)
+                    if not chunk:
+                        continue
+                    # Always pad to B: a dataset-size-dependent
+                    # final-chunk shape would force an extra jit compile
+                    # per distinct remainder (padded windows are
+                    # 1-base/0-layer — free).
+                    packed = _pack(chunk, cfg, B)
+                    try:
+                        faults.check(f"poa.run.{kind}",
+                                     [i for i, _, _ in chunk])
+                        outs = _submit(kernel, packed,
+                                       kind in _PALLAS_KINDS)
+                    except Exception as e:  # noqa: BLE001 — lattice edge
+                        # synchronous dispatch failure: resolve this
+                        # chunk through the lattice right now
+                        # (retry/bisect/demote)
+                        report.record_failure(kind, e)
+                        report.retries += 1
+                        _resolve(pipeline, chunk, None, cfg, B, kind,
+                                 dead_geoms, trim, stats, fallback,
+                                 report, journal)
+                        continue
+                    pending.append((chunk, packed, outs, cfg, kind))
+                    if len(pending) >= q_depth:
+                        _drain(pipeline, pending.popleft(), trim, stats,
+                               fallback, B, dead_geoms, report, journal)
+                if progress:
+                    print(f"[racon_tpu::poa] bucket depth<={depth_bucket} "
+                          f"len<={wl_class}: {len(bucket_jobs)} windows",
+                          file=sys.stderr)
         while pending:
             _drain(pipeline, pending.popleft(), trim, stats, fallback, B,
                    dead_geoms, report, journal)
 
     t0 = time.perf_counter()
-    for i in fallback:
-        polished = pipeline.consensus_cpu_one(i)
-        if journal is not None:
-            _, _, rank, _, _, tid = pipeline.window_info(i)
-            journal.append_window(i, tid, rank, "host",
-                                  pipeline.get_consensus(i), polished)
-        stats["host_fallback"] += 1
+    with obs.span("poa.host_fallback", windows=len(fallback)):
+        for i in fallback:
+            polished = pipeline.consensus_cpu_one(i)
+            if journal is not None:
+                _, _, rank, _, _, tid = pipeline.window_info(i)
+                journal.append_window(i, tid, rank, "host",
+                                      pipeline.get_consensus(i), polished)
+            stats["host_fallback"] += 1
     report.add_wall("host", time.perf_counter() - t0)
     report.record_served("host", stats["host_fallback"])
     report.extra["device_rejected"] = stats["failed"]
@@ -350,25 +378,27 @@ def warm_geometries(window_lengths, match: int, mismatch: int,
     for depth_bucket, wl_class in itertools.product(DEPTH_BUCKETS, classes):
         cfg = make_config(wl_class, depth_bucket, match, mismatch, gap)
         kind = _pick_tier(cfg, use_pallas, requested)
-        while kind != "host":
-            kernel, kind = _live_tier(cfg, B, kind, _WARM_DEAD)
-            if kind == "host":
-                break
-            try:
-                faults.check(f"poa.run.{kind}", ())
-                _unpack(_submit(kernel, _pack([], cfg, B),
-                                kind in _PALLAS_KINDS),
-                        kind in _PALLAS_KINDS)
-                break
-            except Exception as e:  # noqa: BLE001 — same degrade
-                # philosophy as run_consensus_phase: a Mosaic failure on
-                # one geometry must not abort the caller — warm the tier
-                # it will actually fall back to, and remember the failure
-                # so the measured run doesn't retry it
-                _WARM_DEAD.add((cfg, kind))
-                nxt = _next_tier(cfg, kind)
-                _warn_degrade(e, nxt)
-                kind = nxt
+        with obs.span("poa.warmup", depth=depth_bucket, wl_class=wl_class):
+            while kind != "host":
+                kernel, kind = _live_tier(cfg, B, kind, _WARM_DEAD)
+                if kind == "host":
+                    break
+                try:
+                    faults.check(f"poa.run.{kind}", ())
+                    _unpack(_submit(kernel, _pack([], cfg, B),
+                                    kind in _PALLAS_KINDS),
+                            kind in _PALLAS_KINDS)
+                    break
+                except Exception as e:  # noqa: BLE001 — same degrade
+                    # philosophy as run_consensus_phase: a Mosaic failure
+                    # on one geometry must not abort the caller — warm
+                    # the tier it will actually fall back to, and
+                    # remember the failure so the measured run doesn't
+                    # retry it
+                    _WARM_DEAD.add((cfg, kind))
+                    nxt = _next_tier(cfg, kind)
+                    _warn_degrade(e, nxt)
+                    kind = nxt
 
 
 def _pick_tier(cfg, use_pallas: bool, kind: str) -> str:
@@ -453,8 +483,11 @@ def _resolve(pipeline, chunk, outs, cfg, B, kind, dead_geoms, trim, stats,
         if outs is not None and kind == submitted_kind:
             cached = (lambda _o=outs, _p=pallas: _unpack(_o, _p))
         try:
-            pairs, quarantined = rl.serve_with_bisect(
-                chunk, attempt, tier=kind, report=report, cached=cached)
+            with obs.span("poa.chunk", tier=kind, windows=len(chunk),
+                          pipelined=cached is not None):
+                pairs, quarantined = rl.serve_with_bisect(
+                    chunk, attempt, tier=kind, report=report,
+                    cached=cached)
         except rl.TierDead as td:
             dead_geoms.add((cfg, kind))
             nxt = _next_tier(cfg, kind)
@@ -543,8 +576,18 @@ def _build_kernel(cfg, B, use_pallas, kind: str = "v2"):
     if not use_pallas:
         kind = "xla"
     faults.check(f"poa.compile.{kind}")
-    return _build_kernel_cached(cfg, B, use_pallas, kind, _n_devices(),
-                                _platform())
+    # Same build-observability pattern as kernel_cache.device_keyed_cache:
+    # a miss is only known after the call, so the span is retroactive.
+    misses0 = _build_kernel_cached.cache_info().misses
+    t0 = time.monotonic_ns()
+    built = _build_kernel_cached(cfg, B, use_pallas, kind, _n_devices(),
+                                 _platform())
+    if _build_kernel_cached.cache_info().misses != misses0:
+        obs.add_complete("kernel.build", t0, time.monotonic_ns(),
+                         builder=f"poa.{kind}", B=B,
+                         max_nodes=cfg.max_nodes, depth=cfg.depth)
+        obs.count(f"kernel.builds.poa.{kind}")
+    return built
 
 
 @functools.lru_cache(maxsize=64)
@@ -604,11 +647,15 @@ def _export_chunk(pipeline, idxs, cfg, fallback, stats=None, report=None):
         keep = [j for j in range(k) if 0 < wx.lens[j] <= cfg.max_len]
         # Per-class geometry admission (ADVICE.md): a layer longer than
         # THIS class's max_len is dropped here where the old dataset-max
-        # geometry admitted it; counted so serving-mix shifts on
-        # mixed-length datasets stay attributable.
+        # geometry admitted it; counted (report.extra + the named
+        # `poa.layers_dropped_maxlen` metrics counter) so serving-mix
+        # shifts on mixed-length datasets stay attributable.
         if stats is not None:
-            stats["layers_dropped"] += int(
+            dropped = int(
                 sum(1 for ln in wx.lens[:DEPTH_CAP] if ln > cfg.max_len))
+            stats["layers_dropped"] += dropped
+            if dropped:
+                obs.count("poa.layers_dropped_maxlen", dropped)
         if len(keep) < len(wx.lens[:DEPTH_CAP]) and len(keep) < 2:
             fallback.append(i)
             continue

@@ -14,7 +14,7 @@ from typing import List, Tuple
 
 import numpy as np
 
-from . import native
+from . import native, obs
 from .resilience import faults
 
 
@@ -60,9 +60,14 @@ class Pipeline:
             self._h = None
 
     # -- phase 1 ----------------------------------------------------------
+    # Coarse native calls carry `native.*` spans (racon_tpu/obs) so a
+    # trace separates time inside the C++ engine from device batching;
+    # per-window calls (export_window, consensus_cpu_one) are counted in
+    # the drivers instead — a span per window would swamp the buffer.
     def prepare(self) -> None:
-        self._lib.rt_pipeline_prepare(self._h)
-        native.check_error(self._lib)
+        with obs.span("native.prepare"):
+            self._lib.rt_pipeline_prepare(self._h)
+            native.check_error(self._lib)
 
     def num_align_jobs(self) -> int:
         return self._lib.rt_pipeline_num_align_jobs(self._h)
@@ -114,16 +119,19 @@ class Pipeline:
 
     def align_jobs_cpu(self) -> None:
         faults.check("native.call")
-        self._lib.rt_pipeline_align_jobs_cpu(self._h)
-        native.check_error(self._lib)
+        with obs.span("native.align_jobs_cpu"):
+            self._lib.rt_pipeline_align_jobs_cpu(self._h)
+            native.check_error(self._lib)
 
     def build_windows(self) -> None:
-        self._lib.rt_pipeline_build_windows(self._h)
-        native.check_error(self._lib)
+        with obs.span("native.build_windows"):
+            self._lib.rt_pipeline_build_windows(self._h)
+            native.check_error(self._lib)
 
     def initialize(self) -> None:
-        self._lib.rt_pipeline_initialize(self._h)
-        native.check_error(self._lib)
+        with obs.span("native.initialize"):
+            self._lib.rt_pipeline_initialize(self._h)
+            native.check_error(self._lib)
 
     # -- phase 2 ----------------------------------------------------------
     def num_windows(self) -> int:
@@ -170,8 +178,9 @@ class Pipeline:
 
     def consensus_cpu_all(self) -> None:
         faults.check("native.call")
-        self._lib.rt_pipeline_consensus_cpu_all(self._h)
-        native.check_error(self._lib)
+        with obs.span("native.consensus_cpu_all"):
+            self._lib.rt_pipeline_consensus_cpu_all(self._h)
+            native.check_error(self._lib)
 
     def get_consensus(self, i: int) -> bytes:
         """Window i's stored consensus (host- or device-produced)."""
@@ -184,8 +193,10 @@ class Pipeline:
             self._h, i, consensus, len(consensus), 1 if polished else 0)
 
     def stitch(self, drop_unpolished: bool = True) -> List[Tuple[str, str]]:
-        n = self._lib.rt_pipeline_stitch(self._h, 1 if drop_unpolished else 0)
-        native.check_error(self._lib)
+        with obs.span("native.stitch"):
+            n = self._lib.rt_pipeline_stitch(
+                self._h, 1 if drop_unpolished else 0)
+            native.check_error(self._lib)
         out = []
         ln = ctypes.c_uint64()
         for i in range(n):

@@ -20,7 +20,7 @@ from __future__ import annotations
 import time
 from typing import List, Optional, Tuple
 
-from . import config
+from . import config, obs
 from .pipeline import Pipeline
 from .resilience import faults, watchdog
 from .resilience.journal import (Journal, input_fingerprint,
@@ -50,11 +50,14 @@ class CpuPolisher:
 
     def __init__(self, sequences_path: str, overlaps_path: str,
                  target_path: str, journal_path: Optional[str] = None,
-                 resume_journal: bool = False, **kwargs):
+                 resume_journal: bool = False,
+                 trace_path: Optional[str] = None, **kwargs):
         faults.reset()     # per-run firing schedule (deterministic)
         watchdog.reset()   # per-run wedge streaks
         from .analysis import sanitize
         sanitize.reset()   # per-run sanitizer findings
+        obs.reset()        # per-run trace/metrics (disarmed unless armed
+        obs.configure(trace_path=trace_path)  # by --trace / the knobs)
         self._journal = _open_journal(
             (sequences_path, overlaps_path, target_path), "cpu",
             journal_path, resume_journal, kwargs)
@@ -63,18 +66,37 @@ class CpuPolisher:
         self.report = RunReport()
 
     def initialize(self) -> None:
-        self._pipeline.initialize()
+        # The native initialize fuses parse + host alignment + window
+        # building in one ABI call (deliberately not decomposed: the
+        # split Python calls carry extra fault-injection points that
+        # would shift deterministic fault schedules); the host path's
+        # phase attribution is therefore one span.
+        with obs.span("phase.parse", fused="parse+align+window_assign"):
+            self._pipeline.initialize()
 
     def polish(self, drop_unpolished: bool = True) -> List[Tuple[str, str]]:
-        if self._journal is None:
-            self._pipeline.consensus_cpu_all()
-        else:
-            self._polish_journaled(self._journal)
-        out = self._pipeline.stitch(drop_unpolished)
+        with obs.span("phase.poa", tier="host"):
+            if self._journal is None:
+                self._polish_unjournaled()
+            else:
+                self._polish_journaled(self._journal)
+        with obs.span("phase.stitch"):
+            out = self._pipeline.stitch(drop_unpolished)
         if self._journal is not None:
             self._journal.close()
         self.report.finalize().write_env()
+        obs.write_trace()
         return out
+
+    def _polish_unjournaled(self) -> None:
+        pipeline = self._pipeline
+        rep = PhaseReport("consensus", ("host",))
+        rep.total = pipeline.num_windows()
+        t0 = time.perf_counter()
+        pipeline.consensus_cpu_all()
+        rep.add_wall("host", time.perf_counter() - t0)
+        rep.record_served("host", rep.total)
+        self.report.attach(rep)
 
     def _polish_journaled(self, jr: Journal) -> None:
         # Window-at-a-time host consensus so every result is durable the
@@ -111,11 +133,14 @@ class TpuPolisher:
 
     def __init__(self, sequences_path: str, overlaps_path: str,
                  target_path: str, journal_path: Optional[str] = None,
-                 resume_journal: bool = False, **kwargs):
+                 resume_journal: bool = False,
+                 trace_path: Optional[str] = None, **kwargs):
         faults.reset()     # per-run firing schedule (deterministic)
         watchdog.reset()   # per-run wedge streaks
         from .analysis import sanitize
         sanitize.reset()   # per-run sanitizer findings
+        obs.reset()        # per-run trace/metrics (disarmed unless armed
+        obs.configure(trace_path=trace_path)  # by --trace / the knobs)
         self._kwargs = dict(kwargs)
         self._journal = _open_journal(
             (sequences_path, overlaps_path, target_path), "tpu",
@@ -132,26 +157,36 @@ class TpuPolisher:
                 "TPU backend unavailable (racon_tpu.ops failed to import); "
                 "run without --tpu for the host path") from e
 
-        self._pipeline.prepare()
-        stats = run_alignment_phase(self._pipeline,
-                                    journal=self._journal)
+        obs.maybe_start_device_trace()
+        with obs.span("phase.parse"):
+            self._pipeline.prepare()
+        with obs.span("phase.align") as sp:
+            stats = run_alignment_phase(self._pipeline,
+                                        journal=self._journal)
+            sp.set(device=stats.get("device"), host=stats.get("host"))
         self.report.attach(stats.get("report"))
-        self._pipeline.build_windows()
+        with obs.span("phase.window_assign"):
+            self._pipeline.build_windows()
 
     def polish(self, drop_unpolished: bool = True) -> List[Tuple[str, str]]:
         from .ops.poa_driver import run_consensus_phase
 
-        stats = run_consensus_phase(self._pipeline,
-                                    match=self._kwargs.get("match", 3),
-                                    mismatch=self._kwargs.get("mismatch", -5),
-                                    gap=self._kwargs.get("gap", -4),
-                                    trim=self._kwargs.get("trim", True),
-                                    journal=self._journal)
+        with obs.span("phase.poa"):
+            stats = run_consensus_phase(
+                self._pipeline,
+                match=self._kwargs.get("match", 3),
+                mismatch=self._kwargs.get("mismatch", -5),
+                gap=self._kwargs.get("gap", -4),
+                trim=self._kwargs.get("trim", True),
+                journal=self._journal)
         self.report.attach(stats.get("report"))
-        out = self._pipeline.stitch(drop_unpolished)
+        with obs.span("phase.stitch"):
+            out = self._pipeline.stitch(drop_unpolished)
         if self._journal is not None:
             self._journal.close()
         self.report.finalize().write_env()
+        obs.maybe_stop_device_trace()
+        obs.write_trace()
         return out
 
 
@@ -159,7 +194,9 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
                     backend: str = "cpu", **kwargs):
     """Factory. backend: 'cpu' (host oracle) or 'tpu' (device batched).
     `journal_path=`/`resume_journal=` arm the crash-safe result journal
-    (see resilience/journal.py)."""
+    (see resilience/journal.py); `trace_path=` arms the span tracer and
+    writes a Chrome-trace JSON at the end of polish() (see
+    racon_tpu/obs, CLI `--trace`, `RACON_TPU_TRACE`)."""
     if backend == "cpu":
         return CpuPolisher(sequences_path, overlaps_path, target_path,
                            **kwargs)

@@ -60,6 +60,9 @@ ENV = "RACON_TPU_FAULT"
 KNOWN_POINTS = frozenset({
     "align.compile",     # phase-1 device engine kernel build
     "align.run",         # phase-1 device engine, per cohort
+    "align.install",     # phase-1 CIGAR install, per job (after the
+                         # lattice: an escape mid-install must not erase
+                         # the device-served count — see align_driver)
     "poa.compile.ls",    # lockstep consensus kernel build
     "poa.compile.v2",    # one-window consensus kernel build
     "poa.compile.xla",   # XLA-twin consensus kernel build

@@ -54,7 +54,7 @@ import sys
 from dataclasses import dataclass
 from typing import Dict, Optional, Sequence, Set
 
-from .. import config
+from .. import config, obs
 from . import faults
 
 VERSION = 1
@@ -267,14 +267,16 @@ def replay_windows(pipeline, journal: Optional[Journal], n: int,
             report.record_failure("journal", e)
         return set()
     done: Set[int] = set()
-    for i in sorted(journal.windows):
-        if not 0 <= i < n:
-            continue             # defensive: fingerprint should prevent
-        rec = journal.windows[i]
-        pipeline.set_consensus(i, rec.payload, rec.polished)
-        done.add(i)
-        if report is not None:
-            report.record_served("journal")
+    with obs.span("journal.replay", kind="windows") as sp:
+        for i in sorted(journal.windows):
+            if not 0 <= i < n:
+                continue         # defensive: fingerprint should prevent
+            rec = journal.windows[i]
+            pipeline.set_consensus(i, rec.payload, rec.polished)
+            done.add(i)
+            if report is not None:
+                report.record_served("journal")
+        sp.set(replayed=len(done))
     return done
 
 
@@ -295,13 +297,15 @@ def replay_cigars(pipeline, journal: Optional[Journal], n: int,
             report.record_failure("journal", e)
         return set()
     done: Set[int] = set()
-    for job in sorted(journal.cigars):
-        if not 0 <= job < n:
-            continue
-        pipeline.set_job_cigar(job, journal.cigars[job].cigar)
-        done.add(job)
-        if report is not None:
-            report.record_served("journal")
+    with obs.span("journal.replay", kind="cigars") as sp:
+        for job in sorted(journal.cigars):
+            if not 0 <= job < n:
+                continue
+            pipeline.set_job_cigar(job, journal.cigars[job].cigar)
+            done.add(job)
+            if report is not None:
+                report.record_served("journal")
+        sp.set(replayed=len(done))
     return done
 
 

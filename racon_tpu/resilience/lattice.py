@@ -38,7 +38,7 @@ from __future__ import annotations
 import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
-from .. import config
+from .. import config, obs
 # the watchdog moved to its own module (resilience/watchdog.py); the
 # names stay importable from here — every caller and test uses the
 # lattice as the façade
@@ -131,6 +131,10 @@ def serve_with_bisect(items: Sequence, attempt: Callable,
                     report.record_failure(tier, e)
                     if a < n_retries:
                         report.retries += 1
+                if a < n_retries:
+                    obs.event("lattice.retry", tier=tier, attempt=a + 1,
+                              error=type(e).__name__)
+                    obs.count(f"retries.{tier}")
                 if (isinstance(e, WatchdogTimeout)
                         and tracker().is_wedged(tier)):
                     # repeated expiry = wedged jit call; each further
@@ -149,6 +153,9 @@ def serve_with_bisect(items: Sequence, attempt: Callable,
                 return [], [(sub[0], e)]
             if report is not None:
                 report.bisections += 1
+            obs.event("lattice.bisect", tier=tier, size=len(sub),
+                      error=type(e).__name__)
+            obs.count(f"bisections.{tier}")
             mid = len(sub) // 2
             probes = []
             for half in (sub[:mid], sub[mid:]):

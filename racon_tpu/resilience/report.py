@@ -18,7 +18,7 @@ import sys
 import time
 from typing import List, Optional, Tuple
 
-from .. import config
+from .. import config, obs
 
 ENV_REPORT = "RACON_TPU_REPORT"
 
@@ -45,19 +45,29 @@ class PhaseReport:
         self.extra = {}       # phase-specific counters (layers_dropped, …)
 
     # -- recording --------------------------------------------------------
+    # The obs hooks below feed the metrics registry from the same calls
+    # that mutate the report, so the served-sum invariant between the
+    # two (obs.served_sum_check) holds by construction unless some path
+    # serves work while bypassing the report — which is the drift the
+    # cross-check exists to expose.
     def record_served(self, tier: str, n: int = 1) -> None:
         self.served[tier] = self.served.get(tier, 0) + n
+        obs.count(f"served.{self.phase}.{tier}", n)
 
     def record_failure(self, tier: str, exc: BaseException) -> None:
         lst = self.causes.setdefault(tier, [])
         if len(lst) < _MAX_CAUSES:
             lst.append(f"{type(exc).__name__}: {exc}")
+        obs.count(f"failures.{self.phase}.{tier}")
 
     def record_degrade(self, frm: str, to: str,
                        exc: Optional[BaseException] = None) -> None:
         self.degradations.append({
             "from": frm, "to": to,
             "error": f"{type(exc).__name__}: {exc}" if exc else None})
+        obs.event("lattice.demote", phase=self.phase, frm=frm, to=to,
+                  error=type(exc).__name__ if exc else None)
+        obs.count(f"demotions.{self.phase}.{frm}")
 
     def record_quarantine(self, index: int,
                           exc: Optional[BaseException] = None) -> None:
@@ -65,9 +75,12 @@ class PhaseReport:
             self.quarantined.append(int(index))
         if exc is not None:
             self.record_failure("quarantine", exc)
+        obs.event("lattice.quarantine", phase=self.phase, index=int(index))
+        obs.count(f"quarantined.{self.phase}")
 
     def add_wall(self, tier: str, seconds: float) -> None:
         self.wall_s[tier] = self.wall_s.get(tier, 0.0) + seconds
+        obs.observe(f"wall_s.{self.phase}.{tier}", seconds)
 
     # -- views ------------------------------------------------------------
     def served_total(self) -> int:
@@ -123,6 +136,12 @@ class RunReport:
             # --sanitize-report REPORT.json`)
             "sanitize": {"armed": sanitize.enabled(),
                          "findings": sanitize.as_dicts()},
+            # observability snapshot: metrics registry + the served-sum
+            # cross-check against the per-phase counts above (racon_tpu/obs)
+            "obs": {"armed": obs.enabled(),
+                    **({"metrics": obs.snapshot(),
+                        "served_sum": obs.served_sum_check(self.phases)}
+                       if obs.enabled() else {})},
             "wall_s": round(self.wall_s if self.wall_s is not None
                             else time.monotonic() - self._t0, 3),
         }
@@ -133,7 +152,9 @@ class RunReport:
             phase: {"total": r.total, "served": dict(r.served),
                     "retries": r.retries, "bisections": r.bisections,
                     "quarantined": len(r.quarantined),
-                    "degradations": len(r.degradations)}
+                    "degradations": len(r.degradations),
+                    "wall_s": {t: round(s, 4)
+                               for t, s in r.wall_s.items()}}
             for phase, r in self.phases.items()
         }
         stale = config.unknown_env_knobs()

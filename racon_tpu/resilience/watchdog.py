@@ -27,7 +27,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, Optional
 
-from .. import config
+from .. import config, obs
 from . import faults
 
 
@@ -129,6 +129,9 @@ def call_with_watchdog(fn: Callable, timeout: Optional[float] = None,
     if th.is_alive():
         if tier is not None:
             _TRACKER.record_timeout(tier)
+        obs.event("watchdog.timeout", tier=tier, deadline_s=t,
+                  streak=_TRACKER.streak(tier) if tier is not None else 0)
+        obs.count(f"watchdog_timeouts.{tier or 'unknown'}")
         raise WatchdogTimeout(
             f"device call exceeded the {t:.3g}s watchdog", tier=tier,
             elapsed=t)

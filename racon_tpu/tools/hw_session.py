@@ -167,16 +167,42 @@ def _checkpoint_path(state_dir, name):
     return os.path.join(state_dir, f"{name}.json")
 
 
+def _trace_phase_walls(path):
+    """Compact per-phase wall-seconds from a step's Chrome-trace file
+    (the ``phase.*`` complete events racon_tpu.obs emits).  Returns {}
+    when the step wrote no trace or an unparsable one — folding the
+    trace into the log entry is evidence enrichment, never a step
+    failure."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        walls = {}
+        for ev in doc.get("traceEvents", []):
+            nm = ev.get("name", "")
+            if ev.get("ph") == "X" and nm.startswith("phase."):
+                walls[nm[6:]] = round(
+                    walls.get(nm[6:], 0.0) + ev.get("dur", 0) / 1e6, 3)
+        return walls
+    except (OSError, ValueError, TypeError, AttributeError):
+        return {}
+
+
 def _attempt(name, cmd, bound_s, env, cwd):
-    """One bounded attempt.  Returns (outcome, tail, report|None) with
-    outcome in {'ok', 'failed', 'timeout'}."""
+    """One bounded attempt.  Returns (outcome, tail, report|None,
+    phase_walls) with outcome in {'ok', 'failed', 'timeout'}."""
     # every polish inside the step writes its resilience run report here
     # (last polish wins); read back into the durable log entry so a
     # silently degraded tier is visible in the evidence trail
     report_path = os.path.join("/tmp", f"racon_tpu_report_{name}_"
                                f"{os.getpid()}.json")
+    # ...and its obs trace here (same last-polish-wins semantics): the
+    # folded per-phase walls tell a wedged-align step apart from a
+    # wedged-POA step without shipping the whole trace into the log
+    trace_path = os.path.join("/tmp", f"racon_tpu_trace_{name}_"
+                              f"{os.getpid()}.json")
     env = dict(env)
     env.setdefault("RACON_TPU_REPORT", report_path)
+    env.setdefault("RACON_TPU_TRACE", trace_path)
     # start_new_session: a timeout must kill the step's WHOLE process
     # group — bench.py runs its own probe subprocesses, and an orphaned
     # probe wedged on the tunnel would hold the device and poison every
@@ -207,7 +233,13 @@ def _attempt(name, cmd, bound_s, env, cwd):
             os.remove(report_path)
     except (OSError, ValueError):
         pass  # step ran no polish (probe/pins) or died before writing
-    return outcome, tail, report
+    phase_walls = _trace_phase_walls(env["RACON_TPU_TRACE"])
+    if env["RACON_TPU_TRACE"] == trace_path:
+        try:
+            os.remove(trace_path)
+        except OSError:
+            pass
+    return outcome, tail, report, phase_walls
 
 
 def run_step(name, cmd, bound_s, extra_env, retries=1, backoff_s=10.0,
@@ -223,10 +255,11 @@ def run_step(name, cmd, bound_s, extra_env, retries=1, backoff_s=10.0,
     # monotonic: elapsed/backoff accounting must not jump with NTP steps
     t0 = time.monotonic()
     attempts = 0
-    outcome, tail, report = "failed", "", None
+    outcome, tail, report, phase_walls = "failed", "", None, {}
     for k in range(retries + 1):
         attempts += 1
-        outcome, tail, report = _attempt(name, cmd, bound_s, env, cwd)
+        outcome, tail, report, phase_walls = _attempt(name, cmd, bound_s,
+                                                      env, cwd)
         if outcome != "failed" or k == retries:
             break
         # exponential backoff + jitter: give a flapping tunnel room to
@@ -244,6 +277,8 @@ def run_step(name, cmd, bound_s, extra_env, retries=1, backoff_s=10.0,
              "env": extra_env, "tail": tail[-600:]}
     if report is not None:
         entry["report"] = report
+    if phase_walls:
+        entry["phase_wall"] = phase_walls
     return entry
 
 

@@ -1,0 +1,9 @@
+"""Fixture: wall-clock — time.time() span stamps in the obs layer."""
+
+import time
+
+
+def stamp_span(record):
+    t0 = time.time()
+    record("work")
+    return time.time() - t0

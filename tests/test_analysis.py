@@ -64,6 +64,16 @@ def test_device_except_fixture_catches_bare_and_broad():
     assert any("BLE001" in v.message for v in vs)
 
 
+def test_wall_clock_rule_scopes_obs_package():
+    # the tracer's monotonic-clock contract: racon_tpu/obs/ is inside
+    # the wall-clock scope, so a time.time() span there is a violation
+    rel = "racon_tpu/obs/wall_clock_obs.py"
+    vs = lint.run_lint(FIXROOT, paths=[rel],
+                       rules=[RULES_BY_ID["wall-clock"]])
+    assert vs and {v.rule for v in vs} == {"wall-clock"}
+    assert all(v.path == rel for v in vs)
+
+
 def test_knob_docs_rule_fires_when_readme_lacks_knobs():
     # The fixture root's README documents no knobs, so every registered
     # knob is reported undocumented.

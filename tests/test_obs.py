@@ -1,0 +1,314 @@
+"""Observability layer: span tracer + metrics registry + CLI.
+
+Covers the contracts docs/observability.md promises: thread-safe span
+nesting, Chrome-trace schema validity of a real traced polish with all
+five phase spans, the served-sum invariant (metrics counters vs the run
+report, cross-checked — not assumed), byte-identical polished output
+armed vs disarmed (and no trace file when disarmed), the CLI's four
+exit codes, and the align-driver accounting regression: a mid-cohort
+engine death after partial CIGAR installs must not erase the
+device-served count.
+"""
+
+import json
+import random
+import threading
+
+import pytest
+
+import racon_tpu
+from racon_tpu import obs
+from racon_tpu.obs import __main__ as obs_cli
+from racon_tpu.obs.metrics import Histogram, Metrics
+from racon_tpu.obs.tracer import NULL_SPAN, Tracer
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    """Module-level obs state must never leak between tests."""
+    yield
+    obs.reset()
+
+
+# ------------------------------------------------------------ unit: tracer
+
+def test_tracer_thread_pool_nesting():
+    tr = Tracer()
+    # the barrier keeps all 8 threads alive at once: Python reuses
+    # thread idents of finished threads, which would fold the per-thread
+    # name metadata this test asserts on
+    gate = threading.Barrier(8)
+
+    def work(k):
+        gate.wait()
+        t0 = 1000 * k
+        tr.add_complete(f"outer.{k}", t0, t0 + 500, idx=k)
+        tr.add_complete(f"inner.{k}", t0 + 100, t0 + 200)
+        gate.wait()
+
+    threads = [threading.Thread(target=work, args=(k,), name=f"w{k}")
+               for k in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    events = tr.events()
+    assert len(events) == 16
+    # every event carries its recording thread's tid, and each thread's
+    # inner span nests inside its outer span on the same timeline row
+    by_name = {e["name"]: e for e in events}
+    for k in range(8):
+        outer, inner = by_name[f"outer.{k}"], by_name[f"inner.{k}"]
+        assert outer["tid"] == inner["tid"]
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+    # thread-name metadata rides along in the written document
+    doc = tr.to_dict()
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M"}
+    assert {f"w{k}" for k in range(8)} <= names
+
+
+def test_tracer_bounded_buffer():
+    tr = Tracer(max_events=3)
+    for k in range(5):
+        tr.add_instant(f"e{k}")
+    assert len(tr.events()) == 3 and tr.dropped == 2
+    assert tr.to_dict()["otherData"]["dropped_events"] == 2
+
+
+def test_span_records_error_on_exception():
+    tr = Tracer()
+    with pytest.raises(RuntimeError):
+        with obs.Span(tr, "boom", {}):
+            raise RuntimeError("x")
+    (ev,) = tr.events()
+    assert ev["args"]["error"] == "RuntimeError" and ev["dur"] >= 0
+
+
+# ----------------------------------------------------------- unit: metrics
+
+def test_metrics_counters_and_prefix_sum():
+    m = Metrics()
+    m.count("served.consensus.ls", 3)
+    m.count("served.consensus.host")
+    m.count("served.alignment.host", 7)
+    assert m.counter("served.consensus.ls") == 3
+    assert m.prefix_sum("served.consensus.") == 4
+    assert m.prefix_sum("served.") == 11
+
+
+def test_histogram_log2_buckets():
+    h = Histogram()
+    for v in (0.0, 0.5, 1.0, 3.0, 1000.0):
+        h.observe(v)
+    d = h.as_dict()
+    assert d["count"] == 5 and d["min"] == 0.0 and d["max"] == 1000.0
+    assert d["buckets"] == {"0": 1, "1": 2, "4": 1, "1024": 1}
+
+
+# ----------------------------------------------------- unit: armed/disarmed
+
+def test_disarmed_hooks_are_noops():
+    obs.reset()
+    assert not obs.enabled()
+    assert obs.span("anything", k=1) is NULL_SPAN
+    obs.event("x")        # must not raise
+    obs.count("x")
+    obs.observe("x", 1.0)
+    assert obs.snapshot() is None
+    assert obs.write_trace() is None
+
+
+def test_configure_metrics_only_collects_without_file(tmp_path):
+    obs.reset()
+    obs.configure(metrics=True)
+    assert obs.enabled() and obs.trace_path() is None
+    with obs.span("s"):
+        obs.count("c", 2)
+    assert obs.snapshot()["counters"] == {"c": 2}
+    assert obs.write_trace() is None   # no path configured
+    obs.reset()
+
+
+# ------------------------------------------------------------ e2e fixtures
+
+def _write_dataset(tmp_path, n_targets=3, n_reads=4):
+    """Identical-read PAF dataset (no CIGARs, so phase 1 has real align
+    jobs): device- and host-served results are byte-comparable."""
+    rng = random.Random(11)
+    with open(tmp_path / "targets.fasta", "w") as tf, \
+            open(tmp_path / "reads.fasta", "w") as rf, \
+            open(tmp_path / "ovl.paf", "w") as of:
+        for t in range(n_targets):
+            seq = "".join(rng.choice("ACGT") for _ in range(200))
+            tf.write(f">t{t}\n{seq}\n")
+            for i in range(n_reads):
+                rf.write(f">t{t}r{i}\n{seq}\n")
+                of.write(f"t{t}r{i}\t200\t0\t200\t+\tt{t}\t200\t0\t200"
+                         f"\t200\t200\t60\n")
+    return (str(tmp_path / "reads.fasta"), str(tmp_path / "ovl.paf"),
+            str(tmp_path / "targets.fasta"))
+
+
+_ARGS = dict(window_length=100, quality_threshold=10, error_threshold=0.3,
+             match=5, mismatch=-4, gap=-8, num_threads=1)
+
+
+def _tpu_run(paths, monkeypatch, env, **kwargs):
+    base = {"RACON_TPU_PALLAS": "0", "RACON_TPU_POA_KERNEL": "v2",
+            "RACON_TPU_BATCH_WINDOWS": "8"}
+    for k, v in {**base, **env}.items():
+        monkeypatch.setenv(k, v)
+    p = racon_tpu.create_polisher(*paths, backend="tpu", **_ARGS, **kwargs)
+    p.initialize()
+    res = p.polish(True)
+    return res, p
+
+
+# --------------------------------------------------- e2e: traced tpu polish
+
+def test_traced_polish_trace_schema_and_phases(tmp_path, monkeypatch):
+    paths = _write_dataset(tmp_path)
+    trace = tmp_path / "run_trace.json"
+    res, p = _tpu_run(paths, monkeypatch,
+                      {"RACON_TPU_DEVICE_ALIGNER": "1"},
+                      trace_path=str(trace))
+    assert res and trace.exists()
+    doc, errors = obs_cli.load_trace(str(trace))
+    assert errors == [], errors
+    # all five pipeline phases appear as phase.* complete events
+    walls = obs_cli.phase_walls_us(doc)
+    assert set(obs.PHASES) <= set(walls), walls
+    # served-sum invariant: the served.* counters embedded in the trace
+    # reconcile exactly with the run report's per-phase served totals
+    b = obs_cli.breakdown(doc)
+    d = p.report.as_dict()
+    for phase, rep in d["phases"].items():
+        assert sum(b["served"][phase].values()) == rep["total"], (phase, b)
+    assert d["obs"]["armed"] is True
+    assert all(v["ok"] for v in d["obs"]["served_sum"].values()), d["obs"]
+    # the report summary carries the per-phase tier walls bench.py stamps
+    for rep in p.report.summary().values():
+        if isinstance(rep, dict):
+            assert "wall_s" in rep
+
+
+def test_disarmed_polish_byte_identical_no_trace(tmp_path, monkeypatch):
+    paths = _write_dataset(tmp_path)
+    monkeypatch.delenv("RACON_TPU_TRACE", raising=False)
+    monkeypatch.delenv("RACON_TPU_METRICS", raising=False)
+    plain, p_plain = _tpu_run(paths, monkeypatch, {})
+    assert not obs.enabled()
+    assert p_plain.report.as_dict()["obs"] == {"armed": False}
+    trace = tmp_path / "armed_trace.json"
+    traced, _ = _tpu_run(paths, monkeypatch, {}, trace_path=str(trace))
+    assert traced == plain          # observability never changes output
+    assert trace.exists()
+    assert not (tmp_path / "ghost.json").exists()
+    # disarmed run again (fresh polisher resets obs): still no stray file
+    replain, _ = _tpu_run(paths, monkeypatch, {})
+    assert replain == plain
+    assert list(tmp_path.glob("*.json")) == [trace]
+
+
+def test_env_knob_arms_tracing(tmp_path, monkeypatch):
+    paths = _write_dataset(tmp_path)
+    trace = tmp_path / "env_trace.json"
+    res, _ = _tpu_run(paths, monkeypatch,
+                      {"RACON_TPU_TRACE": str(trace)})
+    assert res and trace.exists()
+    doc, errors = obs_cli.load_trace(str(trace))
+    assert errors == []
+    assert doc["racon_tpu"]["metrics"]["counters"]
+
+
+# ------------------------------------- e2e: align accounting under faults
+
+def test_partial_install_death_keeps_device_count(tmp_path, monkeypatch):
+    """Regression (satellite): the xla engine dying mid-cohort AFTER some
+    CIGARs were installed must keep those jobs counted as device-served —
+    the old `stats["device"] = run_jobs(...)` assignment lost them all,
+    over-reporting the host share."""
+    paths = _write_dataset(tmp_path)        # 12 align jobs, all eligible
+    oracle_p = racon_tpu.create_polisher(*paths, backend="cpu", **_ARGS)
+    oracle_p.initialize()
+    oracle = oracle_p.polish(True)
+    res, p = _tpu_run(paths, monkeypatch, {
+        "RACON_TPU_DEVICE_ALIGNER": "1",
+        "RACON_TPU_FAULT": "align.install:window=5",
+    })
+    assert res == oracle            # host finished the rest, byte-equal
+    d = p.report.as_dict()
+    align_rep = d["phases"]["alignment"]
+    # jobs 0..4 were installed before the fault on job 5 killed the
+    # engine: they must survive as device-served
+    assert align_rep["served"].get("xla") == 5, align_rep
+    assert sum(align_rep["served"].values()) == align_rep["total"]
+    assert align_rep["degradations"], "engine death must be recorded"
+
+
+# -------------------------------------------------------------- CLI: exits
+
+def _trace_doc(poa_us):
+    return {"traceEvents": [
+        {"name": "phase.poa", "ph": "X", "ts": 0, "dur": poa_us,
+         "pid": 1, "tid": 1, "args": {}},
+        {"name": "phase.stitch", "ph": "X", "ts": poa_us, "dur": 10,
+         "pid": 1, "tid": 1, "args": {}},
+    ]}
+
+
+def test_cli_exit_0_valid(tmp_path, capsys):
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(_trace_doc(5000)))
+    assert obs_cli.main([str(path)]) == 0
+    assert "phase" in capsys.readouterr().out
+    assert obs_cli.main(["--validate", str(path)]) == 0
+
+
+def test_cli_exit_1_schema_violation(tmp_path):
+    doc = _trace_doc(5000)
+    doc["traceEvents"].append({"name": "bad", "ph": "Z", "ts": 0,
+                               "pid": 1, "tid": 1})
+    doc["traceEvents"].append({"name": "", "ph": "X", "ts": -1, "dur": -2,
+                               "pid": "x", "tid": 1})
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps(doc))
+    assert obs_cli.main(["--validate", str(path)]) == 1
+
+
+def test_cli_exit_2_unreadable(tmp_path):
+    assert obs_cli.main([str(tmp_path / "missing.json")]) == 2
+    notjson = tmp_path / "notjson.json"
+    notjson.write_text("{nope")
+    assert obs_cli.main([str(notjson)]) == 2
+    nottrace = tmp_path / "nottrace.json"
+    nottrace.write_text(json.dumps({"hello": 1}))
+    assert obs_cli.main([str(nottrace)]) == 2
+    # argument errors are exit 2 as well
+    assert obs_cli.main(["--diff", str(notjson)]) == 2
+
+
+def test_cli_exit_3_diff_regression(tmp_path):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(_trace_doc(10_000)))
+    new.write_text(json.dumps(_trace_doc(20_000)))
+    assert obs_cli.main(["--diff", str(old), str(new)]) == 3
+    # within threshold (or shrinking): no regression
+    assert obs_cli.main(["--diff", str(old), str(old)]) == 0
+    assert obs_cli.main(["--diff", str(new), str(old)]) == 0
+    # huge relative growth under --min-delta-us is noise, not regression
+    assert obs_cli.main(["--diff", str(old), str(new),
+                         "--min-delta-us", "50000"]) == 0
+
+
+def test_cli_diff_json_output(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(_trace_doc(10_000)))
+    new.write_text(json.dumps(_trace_doc(40_000)))
+    assert obs_cli.main(["--diff", "--json", str(old), str(new)]) == 3
+    out = json.loads(capsys.readouterr().out)
+    assert any("phase.poa" in r for r in out["regressions"])

@@ -55,6 +55,19 @@ run "racon_tpu.analysis (resilience focus)" \
         racon_tpu/ops/align_driver.py \
         racon_tpu/polisher.py
 
+# 1c. Focused lint over the observability layer: the tracer must stay on
+#     the monotonic clock (wall-clock rule scopes racon_tpu/obs/), its
+#     knobs must stay documented, and the instrumented seams
+#     (kernel_cache, report) must keep their invariants.
+run "racon_tpu.analysis (obs focus)" \
+    env JAX_PLATFORMS=cpu python -m racon_tpu.analysis --paths \
+        racon_tpu/obs/__init__.py \
+        racon_tpu/obs/tracer.py \
+        racon_tpu/obs/metrics.py \
+        racon_tpu/obs/__main__.py \
+        racon_tpu/ops/kernel_cache.py \
+        racon_tpu/resilience/report.py
+
 # 2. ruff (style + pyflakes), configured in pyproject.toml.
 if command -v ruff >/dev/null 2>&1; then
     run "ruff" ruff check .
