@@ -324,7 +324,9 @@ def normalize_entry(e: dict) -> dict:
 
     Also backfills ``phase_wall`` (per-phase wall seconds) for entries
     whose embedded report already carried per-tier walls but predate the
-    explicit stamp."""
+    explicit stamp, and ``cost_model: null`` for entries written before
+    the analytic cost model existed — "no prediction recorded" parses
+    the same for every log generation."""
     if not isinstance(e, dict):
         return e
     unreachable = (e.get("device_status") == "unreachable"
@@ -337,6 +339,8 @@ def normalize_entry(e: dict) -> dict:
         pw = phase_wall(e.get("report"))
         if pw:
             e = dict(e, phase_wall=pw)
+    if "cost_model" not in e:
+        e = dict(e, cost_model=None)
     return e
 
 
@@ -353,6 +357,9 @@ def degraded_result(mbps_cpu: float, note: str = "") -> dict:
         "unit": "Mbp/s",
         "vs_baseline": None,
         "device_status": "unreachable",
+        # no device run, no prediction-vs-measured join — explicit null
+        # keeps normalize_entry a fixed point on fresh entries
+        "cost_model": None,
     }
 
 
@@ -376,6 +383,17 @@ def last_device_measurement():
     except OSError:
         return None
     return entries[-1] if entries else None
+
+
+def _backend_platform():
+    """Measured backend platform for cost-model profile resolution
+    ('auto' -> tpu-v4-lite on tpu, cpu-host otherwise); None when jax
+    never initialized (then resolve_profile falls back to cpu-host)."""
+    try:
+        import jax
+        return jax.devices()[0].platform
+    except Exception:  # noqa: BLE001 — provenance only
+        return None
 
 
 def run(backend: str, paths):
@@ -407,6 +425,11 @@ def main():
         # twin (~30 s/window on this box) — the twin is the degraded
         # tier, not the flow under rehearsal
         os.environ.setdefault("RACON_TPU_PALLAS", "1")
+    # Arm the in-process metrics registry (counters only — no trace file
+    # unless RACON_TPU_TRACE is set) so the measured run counts the
+    # per-bucket DP cells the analytic cost model predicts against
+    # (racon_tpu/obs/costmodel.py).  setdefault: an explicit =0 wins.
+    os.environ.setdefault("RACON_TPU_METRICS", "1")
     paths = dataset()
 
     degraded = not device_healthy()
@@ -478,6 +501,15 @@ def main():
     run("tpu", dataset(mbp=min(MBP, 0.05)))
 
     bp_tpu, dt_tpu, rep_tpu = run("tpu", paths)
+    # The measured run's obs state (cell counters + any trace file) is
+    # the cost model's evidence; the CPU oracle run would reset the
+    # registry and overwrite the trace, so snapshot now and mute tracing
+    # for the oracle pass.
+    from racon_tpu import obs
+    snap_tpu = obs.snapshot()
+    platform = _backend_platform()
+    if config.get_raw("RACON_TPU_TRACE"):
+        os.environ["RACON_TPU_TRACE"] = ""
     bp_cpu, dt_cpu, _ = run("cpu", paths)
 
     mbps_tpu = bp_tpu / dt_tpu / 1e6
@@ -496,6 +528,13 @@ def main():
     # per-window checking overhead — stamp them so they are never
     # compared against clean-run baselines
     sanitized = config.get_bool("RACON_TPU_SANITIZE")
+    # predicted-vs-measured per modeled phase on the run's machine
+    # profile; None when metrics were explicitly disarmed
+    from racon_tpu.obs import costmodel
+    cm = costmodel.bench_cost_model(
+        snap_tpu, phase_wall(rep_tpu),
+        config.get_str("RACON_TPU_MACHINE_PROFILE") or "auto",
+        platform=platform)
     log_device_measurement({
         "mbp": MBP, "input": INPUT, "profile": PROFILE,
         "value": round(mbps_tpu, 4),
@@ -505,6 +544,7 @@ def main():
         "node_factor": config.get_int("RACON_TPU_NODE_FACTOR"),
         "tpu_s": round(dt_tpu, 1), "cpu_s": round(dt_cpu, 1),
         "report": rep_tpu, "phase_wall": phase_wall(rep_tpu),
+        "cost_model": cm,
         **({"sanitize": True} if sanitized else {}),
     })
     print(json.dumps({
@@ -514,6 +554,7 @@ def main():
         "unit": "Mbp/s",
         "vs_baseline": round(mbps_tpu / mbps_cpu, 3),
         "report": rep_tpu, "phase_wall": phase_wall(rep_tpu),
+        "cost_model": cm,
         **({"sanitize": True} if sanitized else {}),
     }))
     print(f"[bench] tpu: {bp_tpu} bp in {dt_tpu:.1f}s | "

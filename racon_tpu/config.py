@@ -106,6 +106,12 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
     _k("RACON_TPU_TRACE_DEVICE", None, "bool",
        "with tracing armed on a real TPU backend, also capture a "
        "jax.profiler device trace next to the trace file"),
+    _k("RACON_TPU_COST_MODEL", "1", "bool",
+       "stamp analytic cost predictions into kernel.build spans and "
+       "bench entries (obs/costmodel.py; 0 disables)"),
+    _k("RACON_TPU_MACHINE_PROFILE", "auto", "str",
+       "machine profile for cost-model predictions: auto | cpu-host | "
+       "tpu-v4-lite (auto picks by backend platform)"),
     # -- test / bench knobs ----------------------------------------------
     _k("RACON_TPU_HW_TESTS", None, "bool",
        "assert exact on-hardware pins against a real TPU backend",

@@ -83,6 +83,12 @@ def configure(trace_path: Optional[str] = None,
         _trace_path = trace_path
         _tracer = Tracer()
         _metrics = Metrics()
+        # every finished span also lands in a span_us.<name> log2
+        # histogram, so the CLI breakdown gets p50/p99 per span name
+        # even when the bounded event buffer truncated the timeline
+        m = _metrics
+        _tracer.on_complete = \
+            lambda name, dur_us: m.observe(f"span_us.{name}", dur_us)
 
 
 def enabled() -> bool:
@@ -162,6 +168,20 @@ def served_sum_check(phases) -> dict:
 
 # -- export ----------------------------------------------------------------
 
+def _platform() -> Optional[str]:
+    """Backend platform for the trace provenance stamp.  Reads jax only
+    when the run already imported it (a traced polish always has) — this
+    module must stay importable, and write_trace callable, without a jax
+    dependency."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return jax.devices()[0].platform
+    except Exception:  # noqa: BLE001 — provenance only, never fail a write
+        return None
+
+
 def write_trace() -> Optional[str]:
     """Write the Chrome-trace JSON (metrics snapshot embedded) to the
     configured path.  Returns the path written, or None when tracing is
@@ -171,7 +191,7 @@ def write_trace() -> Optional[str]:
     if t is None or not path:
         return None
     try:
-        t.write(path, metrics=snapshot())
+        t.write(path, metrics=snapshot(), platform=_platform())
     except OSError as e:
         print(f"[racon_tpu::obs] WARNING: cannot write trace {path}: {e}",
               file=sys.stderr)

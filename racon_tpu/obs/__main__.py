@@ -1,13 +1,28 @@
 """``python -m racon_tpu.obs`` — read a trace written via ``--trace`` /
 ``RACON_TPU_TRACE``: validate the Chrome-trace schema, render a
-phase/tier breakdown, or diff two runs.
+phase/tier breakdown, diff two runs, or run the cost-model tooling.
+
+Legacy flag form (kept stable for CI and tests)::
+
+    python -m racon_tpu.obs run.json              # breakdown
+    python -m racon_tpu.obs --validate run.json   # schema check
+    python -m racon_tpu.obs --diff old.json new.json
+
+Subcommands (the cost-model surface, same exit-code contract)::
+
+    python -m racon_tpu.obs model [--profile P] [--lowered]
+    python -m racon_tpu.obs validate run.json [--profile P]
+    python -m racon_tpu.obs bench [extra.json ...] [--threshold T]
 
 Exit codes (CI keys off these):
 
-* 0 — trace valid (and, in ``--diff`` mode, no regression)
+* 0 — trace valid / prediction within the profile's declared bound /
+  no bench regression
 * 1 — schema violation(s) in an otherwise readable trace
-* 2 — file unreadable / not JSON / not a trace object
-* 3 — ``--diff`` found a phase regression past ``--threshold``
+* 2 — file unreadable / not JSON / not a trace object / bad arguments
+* 3 — regression: ``--diff`` phase regression past ``--threshold``,
+  ``validate`` prediction error past the machine profile's declared
+  bound, or ``bench`` history regression
 """
 
 from __future__ import annotations
@@ -18,6 +33,8 @@ import sys
 from typing import Dict, List, Tuple
 
 from . import PHASES
+from . import bench_track, costmodel
+from .metrics import hist_quantile
 
 _VALID_PH = {"X", "B", "E", "i", "I", "M", "C"}
 
@@ -76,20 +93,54 @@ def phase_walls_us(doc: dict) -> Dict[str, int]:
     return walls
 
 
-def _counters(doc: dict) -> Dict[str, int]:
+def _metrics_doc(doc: dict) -> dict:
     m = doc.get("racon_tpu")
     if isinstance(m, dict):
         m = m.get("metrics")
-    if isinstance(m, dict):
-        c = m.get("counters")
-        if isinstance(c, dict):
-            return c
-    return {}
+    return m if isinstance(m, dict) else {}
+
+
+def _counters(doc: dict) -> Dict[str, int]:
+    c = _metrics_doc(doc).get("counters")
+    return c if isinstance(c, dict) else {}
+
+
+def span_quantiles(doc: dict) -> Dict[str, dict]:
+    """Per-span-name p50/p99 (µs) from the ``span_us.*`` log2 histograms
+    the armed tracer feeds into the metrics registry.  Quantiles are
+    bucket upper bounds — right to within the log2 bucket width."""
+    out: Dict[str, dict] = {}
+    hists = _metrics_doc(doc).get("histograms")
+    if not isinstance(hists, dict):
+        return out
+    for name, h in sorted(hists.items()):
+        if not name.startswith("span_us.") or not isinstance(h, dict):
+            continue
+        p50 = hist_quantile(h, 0.50)
+        p99 = hist_quantile(h, 0.99)
+        if p50 is None:
+            continue
+        out[name[len("span_us."):]] = {
+            "count": h.get("count", 0), "p50_us": p50, "p99_us": p99,
+            "max_us": h.get("max"),
+        }
+    return out
+
+
+def dropped_events(doc: dict) -> int:
+    od = doc.get("otherData")
+    if isinstance(od, dict):
+        try:
+            return int(od.get("dropped_events", 0))
+        except (TypeError, ValueError):
+            return 0
+    return 0
 
 
 def breakdown(doc: dict) -> dict:
-    """Phase walls, per-tier served counters, and event counts — the
-    machine-readable form behind the rendered table."""
+    """Phase walls, per-tier served counters, span-duration quantiles,
+    and event counts — the machine-readable form behind the rendered
+    table."""
     walls = phase_walls_us(doc)
     counters = _counters(doc)
     served: Dict[str, Dict[str, int]] = {}
@@ -103,12 +154,16 @@ def breakdown(doc: dict) -> dict:
             events[ev.get("name", "?")] = events.get(ev.get("name", "?"),
                                                      0) + 1
     return {"phase_us": walls, "served": served, "events": events,
-            "counters": counters}
+            "counters": counters, "span_quantiles": span_quantiles(doc),
+            "dropped_events": dropped_events(doc)}
 
 
 def render(doc: dict, path: str) -> str:
     b = breakdown(doc)
     lines = [f"trace: {path}"]
+    if b["dropped_events"]:
+        lines.append(f"  WARNING: {b['dropped_events']} event(s) dropped "
+                     f"past the bounded buffer — totals are lower bounds")
     total = sum(b["phase_us"].values())
     lines.append("-- phases " + "-" * 34)
     order = [p for p in PHASES if p in b["phase_us"]]
@@ -125,6 +180,12 @@ def render(doc: dict, path: str) -> str:
             mix = "  ".join(f"{t}={n}" for t, n in sorted(tiers.items()))
             lines.append(f"  {phase:<16s} {mix}  (sum="
                          f"{sum(tiers.values())})")
+    if b["span_quantiles"]:
+        lines.append("-- span durations (p50/p99 from log2 histograms) --")
+        for name, q in b["span_quantiles"].items():
+            lines.append(f"  {name:<24s} n={q['count']:<6d} "
+                         f"p50<={q['p50_us'] / 1e3:>9.2f} ms  "
+                         f"p99<={q['p99_us'] / 1e3:>9.2f} ms")
     if b["events"]:
         lines.append("-- events " + "-" * 34)
         for name, n in sorted(b["events"].items()):
@@ -133,26 +194,172 @@ def render(doc: dict, path: str) -> str:
 
 
 def diff(old: dict, new: dict, threshold: float,
-         min_delta_us: int) -> List[str]:
-    """Phase-wall regressions: new > old*(1+threshold) and the absolute
-    growth exceeds ``min_delta_us`` (filters noise on tiny runs)."""
+         min_delta_us: int) -> Tuple[List[str], List[str]]:
+    """Phase-wall regressions plus one-sided-phase flags.
+
+    A phase present on only one side is *flagged* (``only-in-old`` /
+    ``only-in-new``) with the missing side treated as 0 — a resumed run
+    that replayed align from the journal legitimately has no
+    ``phase.align`` span, and that must read as a structural difference,
+    not a crash or an infinite-percent regression.  Regressions keep the
+    exit-3 contract: new > old*(1+threshold) and absolute growth past
+    ``min_delta_us``."""
     ow, nw = phase_walls_us(old), phase_walls_us(new)
-    regressions = []
+    regressions, flags = [], []
     for phase in sorted(set(ow) | set(nw)):
         o, n = ow.get(phase, 0), nw.get(phase, 0)
+        if phase not in ow or phase not in nw:
+            side = "new" if phase not in ow else "old"
+            us = n if side == "new" else o
+            flags.append(f"phase.{phase}: only-in-{side} "
+                         f"({us / 1e3:.2f} ms; missing side counted as 0)")
         if n > o * (1.0 + threshold) and (n - o) > min_delta_us:
-            pct = (100.0 * (n - o) / o) if o else float("inf")
+            pct = f"+{100.0 * (n - o) / o:.0f}%" if o else "only-in-new"
             regressions.append(
                 f"phase.{phase}: {o / 1e3:.2f} ms -> {n / 1e3:.2f} ms "
-                f"(+{pct:.0f}%, threshold {threshold * 100:.0f}%)")
-    return regressions
+                f"({pct}, threshold {threshold * 100:.0f}%)")
+    return regressions, flags
+
+
+# -- subcommands -----------------------------------------------------------
+
+def _profile_for(doc: dict, name: str) -> costmodel.MachineProfile:
+    """'auto' resolves from the platform stamped into the trace at write
+    time (falls back to cpu-host when absent)."""
+    platform = None
+    od = doc.get("otherData")
+    if isinstance(od, dict):
+        platform = od.get("platform")
+    return costmodel.resolve_profile(name, platform)
+
+
+def cmd_model(args) -> int:
+    try:
+        prof = costmodel.profile(args.profile if args.profile != "auto"
+                                 else "cpu-host")
+    except KeyError as e:
+        print(f"[obs] {e}", file=sys.stderr)
+        return 2
+    rows = costmodel.model_rows(
+        prof, window_lengths=args.window_length or
+        costmodel.AUDIT_WINDOW_LENGTHS, lowered=args.lowered)
+    if args.as_json:
+        print(json.dumps({"profile": prof.name, "rows": rows}, indent=2))
+    else:
+        print(costmodel.render_model(rows, prof))
+    return 0
+
+
+def cmd_validate(args) -> int:
+    try:
+        doc, errors = load_trace(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"[obs] cannot read trace {args.trace}: {e}", file=sys.stderr)
+        return 2
+    if errors:
+        for err in errors:
+            print(f"[obs] {args.trace}: {err}", file=sys.stderr)
+        return 1
+    try:
+        prof = _profile_for(doc, args.profile)
+    except KeyError as e:
+        print(f"[obs] {e}", file=sys.stderr)
+        return 2
+    v = costmodel.validate_trace(doc, prof)
+    if args.as_json:
+        print(json.dumps(v, indent=2))
+    else:
+        print(costmodel.render_validation(v))
+    return 0 if v["ok"] else 3
+
+
+def cmd_bench(args) -> int:
+    entries, problems = bench_track.load_history(
+        root=args.root, extra_paths=args.extra)
+    for p in problems:
+        print(f"[obs] bench history problem: {p}", file=sys.stderr)
+    if problems:
+        return 2
+    if not entries:
+        print("[obs] no bench history found", file=sys.stderr)
+        return 2
+    result = bench_track.trend(entries, threshold=args.threshold,
+                               min_delta_s=args.min_delta_s)
+    if args.as_json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(bench_track.render(result))
+    return 3 if result["regressions"] else 0
+
+
+def _sub_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m racon_tpu.obs",
+        description="cost-model tooling over racon_tpu traces and bench "
+                    "history (see docs/benchmarks.md)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    m = sub.add_parser("model", help="print the predicted cost grid")
+    m.add_argument("--profile", default="cpu-host",
+                   help="machine profile (%s)" % ", ".join(
+                       sorted(costmodel.PROFILES)))
+    m.add_argument("--window-length", type=int, action="append",
+                   help="window length(s) to tabulate (repeatable; "
+                        "default: the audit lengths)")
+    m.add_argument("--lowered", action="store_true",
+                   help="refine FLOPs/bytes via jax Lowered.cost_analysis "
+                        "where available (imports jax; slower)")
+    m.add_argument("--json", action="store_true", dest="as_json")
+    m.set_defaults(fn=cmd_model)
+
+    v = sub.add_parser("validate",
+                       help="join predictions against a measured trace; "
+                            "exit 3 when error exceeds the profile's "
+                            "declared bound")
+    v.add_argument("trace")
+    v.add_argument("--profile", default="auto",
+                   help="machine profile, or 'auto' to pick from the "
+                        "platform stamped in the trace (default)")
+    v.add_argument("--json", action="store_true", dest="as_json")
+    v.set_defaults(fn=cmd_validate)
+
+    b = sub.add_parser("bench",
+                       help="trend + regression gate over BENCH_r*.json "
+                            "and docs/device_bench_log.jsonl")
+    b.add_argument("extra", nargs="*",
+                   help="extra bench-entry JSON file(s) appended to the "
+                        "history (newest last) — CI injects a synthetic "
+                        "regression here as a self-test")
+    b.add_argument("--root", default=bench_track._REPO_ROOT,
+                   help="repo root holding BENCH_r*.json (default: this "
+                        "checkout)")
+    b.add_argument("--threshold", type=float, default=0.25,
+                   help="relative drop/growth gated per series "
+                        "(default 0.25)")
+    b.add_argument("--min-delta-s", type=float, default=0.05,
+                   help="ignore phase-wall growth smaller than this many "
+                        "seconds (default 0.05)")
+    b.add_argument("--json", action="store_true", dest="as_json")
+    b.set_defaults(fn=cmd_bench)
+    return p
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] in ("model", "validate", "bench"):
+        try:
+            args = _sub_parser().parse_args(argv)
+        except SystemExit as e:
+            return 2 if e.code not in (0, None) else 0
+        return args.fn(args)
+
     p = argparse.ArgumentParser(
         prog="python -m racon_tpu.obs",
         description="validate / summarize / diff racon_tpu trace files "
-                    "(Chrome-trace JSON from --trace / RACON_TPU_TRACE)")
+                    "(Chrome-trace JSON from --trace / RACON_TPU_TRACE); "
+                    "subcommands model/validate/bench run the cost-model "
+                    "tooling")
     p.add_argument("trace", nargs="+",
                    help="trace file (two files with --diff: OLD NEW)")
     p.add_argument("--validate", action="store_true",
@@ -193,11 +400,14 @@ def main(argv=None) -> int:
         docs.append(doc)
 
     if args.diff:
-        regressions = diff(docs[0], docs[1], args.threshold,
-                           args.min_delta_us)
+        regressions, flags = diff(docs[0], docs[1], args.threshold,
+                                  args.min_delta_us)
         if args.as_json:
-            print(json.dumps({"regressions": regressions}, indent=2))
+            print(json.dumps({"regressions": regressions,
+                              "only_in": flags}, indent=2))
         else:
+            for fl in flags:
+                print(f"[obs] NOTE: {fl}")
             for r in regressions:
                 print(f"[obs] REGRESSION: {r}")
             if not regressions:
@@ -207,12 +417,18 @@ def main(argv=None) -> int:
 
     doc = docs[0]
     if args.validate:
+        dropped = dropped_events(doc)
         if not args.as_json:
             print(f"[obs] OK: {args.trace[0]} is valid Chrome-trace JSON "
                   f"({len(doc['traceEvents'])} events)")
+            if dropped:
+                print(f"[obs] WARNING: {dropped} event(s) were dropped "
+                      f"past the tracer's bounded buffer — the trace is "
+                      f"truncated, not complete")
         else:
             print(json.dumps({"valid": True,
-                              "events": len(doc["traceEvents"])}))
+                              "events": len(doc["traceEvents"]),
+                              "dropped_events": dropped}))
         return 0
     if args.as_json:
         print(json.dumps(breakdown(doc), indent=2))

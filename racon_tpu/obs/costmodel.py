@@ -1,0 +1,664 @@
+"""Analytic per-tier cost model for the kernel grid + roofline accounting.
+
+ROADMAP item 3's hardware-free half: before cutting serial DP steps we
+need to *predict* where the cycles go — per POA bucket (DEPTH_BUCKETS x
+128-lane window class, tier ls/v2/xla) and per aligner bucket — and
+check those predictions against what `--trace` actually measured.  The
+vocabulary is the one AnySeq/GPU and gpuPairHMM use to justify DP
+optimizations: cell updates per second against a machine roofline.
+
+Three layers:
+
+* **CostEstimate** — closed-form FLOPs / HBM bytes / serial DP steps per
+  window (POA) or per job (aligner), parameterized by bucket shape.
+  Where a lowered kernel is on hand, `lowered_cost()` asks
+  ``jax.stages.Lowered.cost_analysis()`` instead and falls back to the
+  closed forms (the XLA estimate has no notion of our serial rank loop,
+  so serial steps always come from the closed form).
+* **MachineProfile** — peak FLOP/s, HBM bandwidth, serial-step latency,
+  host engine cell rates, and the prediction-error bound the profile
+  *declares* it can hold.  ``cpu-host`` (this repo's CI box class) and
+  ``tpu-v4-lite`` (anchored to the dp_cost_probe measurements in
+  docs/benchmarks.md) ship built in.
+* **Roofline verdict** — predicted wall = max(compute, bandwidth,
+  serial-step term); whichever term wins classifies the bucket as
+  compute-bound / bandwidth-bound / serial-step-bound.  The measured
+  0.188x story is the serial-step term winning by ~40x, which is why
+  ROADMAP's next cut is rank-loop steps, not FLOPs.
+
+Everything here is stdlib-only (the obs package contract): the kernel
+grid constants are mirrored from ``racon_tpu.ops`` and pinned equal by
+tests/test_costmodel.py, so this module stays importable without jax.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional
+
+# -- kernel grid constants (mirrored from racon_tpu.ops; parity-tested) ----
+
+#: poa_driver.DEPTH_BUCKETS — layer-count buckets windows batch into.
+DEPTH_BUCKETS = (8, 32, 200)
+#: align.BUCKETS — (max length, band) buckets for the xla aligner.
+ALIGN_BUCKETS = ((1024, 256), (2048, 512), (4096, 1024), (8192, 2048))
+#: poa_pallas_ls.G — windows per lane-lockstep program (amortizes the
+#: serial rank loop across G windows).
+LS_GROUP = 8
+#: poa_driver.AUDIT_WINDOW_LENGTHS — the window lengths the grid is
+#: audited (and documented) at.
+AUDIT_WINDOW_LENGTHS = (500, 1000)
+
+POA_TIERS = ("ls", "v2", "xla")
+
+#: Graph ranks per backbone position: POA graphs grow past the backbone
+#: as divergent layer bases fork nodes.  λ at ~30x measured ~2x
+#: (docs/benchmarks.md: ~1000 ranks over a 500-base backbone).
+NODE_GROWTH = 2.0
+
+#: Vector ops per DP cell (sub/ins/del merge, weight add, move select,
+#: cummax contribution) — same math in all three tiers.
+POA_FLOPS_PER_CELL = 14.0
+#: HBM bytes per admitted layer base (u8 code + i32 weight streamed in).
+POA_LAYER_BYTES = 5.0
+#: Aligner DP: add/min/select + move byte per cell.
+ALIGN_FLOPS_PER_CELL = 10.0
+ALIGN_BYTES_PER_CELL = 2.0   # move byte written + amortized re-read
+
+
+def window_class(bb_len: int) -> int:
+    """128-lane geometry class (mirror of poa_driver.window_class)."""
+    return max(128, (bb_len + 127) // 128 * 128)
+
+
+def band_need(n: int, m: int) -> int:
+    """Band the aligner actually needs for an (n, m) pair — the 10%%
+    auto-band rule (mirror of align_pallas.band_for's `need`)."""
+    return abs(m - n) + max(n, m) // 10 + 2
+
+
+class CostEstimate(NamedTuple):
+    """Predicted work for one unit (window / align job / batch)."""
+
+    flops: float          # vector FLOPs (or int-ops; the VPU doesn't care)
+    hbm_bytes: float      # bytes that must cross HBM
+    serial_steps: float   # latency-chained DP steps (rank loop / row scan)
+
+    def scaled(self, k: float) -> "CostEstimate":
+        return CostEstimate(self.flops * k, self.hbm_bytes * k,
+                            self.serial_steps * k)
+
+    def plus(self, other: "CostEstimate") -> "CostEstimate":
+        return CostEstimate(self.flops + other.flops,
+                            self.hbm_bytes + other.hbm_bytes,
+                            self.serial_steps + other.serial_steps)
+
+
+ZERO = CostEstimate(0.0, 0.0, 0.0)
+
+
+@dataclass(frozen=True)
+class MachineProfile:
+    """What the machine can do — the denominator under every estimate.
+
+    ``error_bound_ratio`` is the bound the profile *declares*: `obs
+    validate` fails (exit 3) when max(pred/meas, meas/pred) on a modeled
+    phase exceeds it.  The CPU profile's bound is deliberately loose
+    (XLA-on-CPU throughput varies ~4x across host classes); the TPU
+    profile is the calibration target and declares a tight one.
+    """
+
+    name: str
+    description: str
+    clock_hz: float              # core clock (cycles tables only)
+    peak_flops: float            # sustained vector FLOP/s for one program
+    hbm_bytes_per_s: float       # sustained HBM bandwidth
+    serial_step_s: float         # latency per serial DP step
+    host_poa_cells_per_s: float  # host SIMD POA engine
+    host_align_cells_per_s: float  # host Myers aligner
+    error_bound_ratio: float     # declared validate bound (>= 1)
+
+
+PROFILES: Dict[str, MachineProfile] = {p.name: p for p in (
+    MachineProfile(
+        name="cpu-host",
+        description="1-core x86 host running the XLA twin kernels "
+                    "(the CI traced-bench configuration); host engines "
+                    "are the native SIMD paths",
+        clock_hz=3.0e9,
+        # XLA CPU executes the scan-based DP kernels essentially
+        # scalar + dispatch-bound; calibrated against traced runs of
+        # the v2 XLA twin on this repo's dev box.
+        peak_flops=2.0e9,
+        hbm_bytes_per_s=1.0e10,
+        # One serial DP step on this profile is one XLA while-loop
+        # iteration over the whole window batch — dispatch-dominated on
+        # CPU, measured at ~2.6 ms/step on the 1-core dev box (traced
+        # 0.002 Mbp forced-device bench: 28.7k steps -> 73.6 s poa
+        # phase). This is what makes the forced-device dry run hundreds
+        # of times slower than the host SIMD path, and it is why the
+        # error bound below is wide: runner-class machines differ in
+        # dispatch overhead far more than in FLOP rate.
+        serial_step_s=2.5e-3,
+        host_poa_cells_per_s=1.2e9,    # 1.57 Gcells/s AVX-512 measured,
+                                       # derated for short-window overhead
+        host_align_cells_per_s=6.0e8,  # banded block-Myers, measured class
+        error_bound_ratio=8.0,
+    ),
+    MachineProfile(
+        name="tpu-v4-lite",
+        description="single TPU chip of the v4-lite/v5e class; "
+                    "serial_step_s anchored to the dp_cost_probe "
+                    "measurement (~2.7 us/rank at production geometry, "
+                    "docs/benchmarks.md)",
+        clock_hz=9.4e8,
+        peak_flops=2.0e12,           # VPU f32/i32 class, one core
+        hbm_bytes_per_s=4.0e11,
+        serial_step_s=2.7e-6,        # measured: latency-bound rank loop
+        host_poa_cells_per_s=1.5e9,  # host VM SIMD engines
+        host_align_cells_per_s=1.0e9,
+        error_bound_ratio=2.5,
+    ),
+)}
+
+
+def profile(name: str) -> MachineProfile:
+    """Look up a machine profile; raises KeyError with the valid names."""
+    try:
+        return PROFILES[name]
+    except KeyError:
+        raise KeyError(f"unknown machine profile {name!r}; "
+                       f"available: {sorted(PROFILES)}") from None
+
+
+def resolve_profile(name: str, platform: Optional[str] = None
+                    ) -> MachineProfile:
+    """'auto' picks by backend platform (tpu -> tpu-v4-lite, else
+    cpu-host); anything else must be a registered profile name."""
+    if name in ("", "auto", None):
+        return PROFILES["tpu-v4-lite" if platform == "tpu" else "cpu-host"]
+    return profile(name)
+
+
+# -- closed-form estimates -------------------------------------------------
+
+def poa_window_cost(depth: int, wl_class: int, tier: str) -> CostEstimate:
+    """Predicted work for ONE window of `depth` admitted layers in a
+    `wl_class` geometry class served by `tier`.
+
+    The DP: each layer aligns against the window graph — ranks x layer
+    length cells, with the rank loop latency-chained (each rank's row
+    depends on its predecessors' rows).  Graph update + consensus ride
+    inside the same rank-step constants.
+    """
+    ranks = NODE_GROWTH * wl_class
+    cells = depth * ranks * wl_class
+    flops = cells * POA_FLOPS_PER_CELL
+    # HBM traffic: layer bases/weights streamed in, consensus out; the H
+    # matrix lives in VMEM (v2 ring / ls ring), so it does not cross HBM.
+    hbm = depth * wl_class * POA_LAYER_BYTES + 2 * wl_class * 5
+    steps = depth * ranks
+    if tier == "ls":
+        # G windows share one program's rank loop: the serial term
+        # amortizes per window, the cell work does not.
+        steps /= LS_GROUP
+    return CostEstimate(flops, hbm, steps)
+
+
+def align_job_cost(cap: int, band: int, tier: str = "xla") -> CostEstimate:
+    """Predicted work for ONE aligner job in a (cap, band) bucket.
+
+    xla: full cap x band moves-matrix DP (scan over cap rows, then a
+    2*cap traceback while-loop).  hirschberg: fwd+bwd distance passes
+    over the recursion tree ~ 2x the base DP, no stored matrix.
+    """
+    cells = float(cap) * band
+    if tier == "hirschberg":
+        cells *= 2.0
+        steps = 4.0 * cap          # row scans across recursion levels
+        hbm = cap * 2.0            # sequences only; no moves matrix
+    else:
+        steps = 3.0 * cap          # row scan + traceback chain
+        hbm = cells * ALIGN_BYTES_PER_CELL
+    return CostEstimate(cells * ALIGN_FLOPS_PER_CELL, hbm, steps)
+
+
+def roofline(est: CostEstimate, prof: MachineProfile):
+    """(seconds, verdict): predicted wall is the max of the three
+    roofline terms; the winning term names the bound."""
+    terms = {
+        "compute-bound": est.flops / prof.peak_flops,
+        "bandwidth-bound": est.hbm_bytes / prof.hbm_bytes_per_s,
+        "serial-step-bound": est.serial_steps * prof.serial_step_s,
+    }
+    verdict = max(terms, key=lambda k: terms[k])
+    return terms[verdict], verdict
+
+
+def host_poa_seconds(cells: float, prof: MachineProfile) -> float:
+    return cells / prof.host_poa_cells_per_s
+
+
+def host_align_seconds(cells: float, prof: MachineProfile) -> float:
+    return cells / prof.host_align_cells_per_s
+
+
+# -- optional jax.stages.Lowered.cost_analysis ----------------------------
+
+def lowered_cost(lowered) -> Optional[CostEstimate]:
+    """FLOPs/bytes from a ``jax.stages.Lowered`` (or anything exposing
+    ``cost_analysis()``), serial steps left 0 — XLA's estimate has no
+    notion of the rank loop's latency chain, so callers must merge this
+    with a closed form for the serial term.  Returns None when the
+    backend provides no cost analysis (CPU often returns {} or raises).
+    """
+    try:
+        ca = lowered.cost_analysis()
+    except Exception:  # noqa: BLE001 — optional-path probe
+        return None
+    if isinstance(ca, (list, tuple)):   # older jax: one dict per device
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict) or not ca:
+        return None
+    flops = float(ca.get("flops", 0.0))
+    byt = float(ca.get("bytes accessed", 0.0))
+    if flops <= 0.0 and byt <= 0.0:
+        return None
+    return CostEstimate(flops, byt, 0.0)
+
+
+def lowered_poa_cost(depth: int, wl_class: int, tier: str
+                     ) -> Optional[CostEstimate]:
+    """Best-effort: lower the real POA kernel for this bucket and read
+    XLA's own FLOPs/bytes, keeping the closed-form serial term.  Imports
+    jax and traces the kernel — minutes-cheap on CPU for the xla tier,
+    potentially slow for pallas tiers; callers gate it (``obs model
+    --lowered``).  Any failure returns None (closed form stands)."""
+    try:
+        import jax
+        import numpy as np
+
+        from ..ops import poa as poa_mod
+        from ..ops import poa_driver
+
+        cfg = poa_driver.make_config(wl_class, depth, 5, -4, -8)
+        if tier != "xla":
+            return None   # pallas lowerings carry no useful cost_analysis
+        kernel = poa_mod.build_poa_kernel(cfg)
+        B = 1
+        args = (
+            np.zeros((B, cfg.max_backbone), np.uint8),
+            np.zeros((B, cfg.max_backbone), np.int32),
+            np.ones(B, np.int32),
+            np.zeros(B, np.int32),
+            np.zeros((B, cfg.depth, cfg.max_len), np.uint8),
+            np.zeros((B, cfg.depth, cfg.max_len), np.int32),
+            np.zeros((B, cfg.depth), np.int32),
+            np.zeros((B, cfg.depth), np.int32),
+            np.zeros((B, cfg.depth), np.int32),
+        )
+        est = lowered_cost(jax.jit(kernel).lower(*args))
+        del jax
+        if est is None:
+            return None
+        closed = poa_window_cost(depth, wl_class, tier)
+        return CostEstimate(est.flops, est.hbm_bytes or closed.hbm_bytes,
+                            closed.serial_steps)
+    except Exception:  # noqa: BLE001 — optional-path probe
+        return None
+
+
+# -- the predicted grid (obs model) ----------------------------------------
+
+def model_rows(prof: MachineProfile,
+               window_lengths=AUDIT_WINDOW_LENGTHS,
+               tiers=POA_TIERS, depth: Optional[int] = None,
+               lowered: bool = False) -> List[dict]:
+    """One row per (tier, depth bucket, window class) plus one per
+    aligner bucket: predicted FLOPs / HBM bytes / serial steps /
+    wall+cycles per unit, and the roofline verdict."""
+    rows = []
+    classes = sorted({window_class(w) for w in window_lengths})
+    for tier in tiers:
+        for d in DEPTH_BUCKETS if depth is None else (depth,):
+            for c in classes:
+                est = None
+                if lowered:
+                    est = lowered_poa_cost(d, c, tier)
+                if est is None:
+                    est = poa_window_cost(d, c, tier)
+                s, verdict = roofline(est, prof)
+                rows.append({
+                    "kind": "poa", "tier": tier, "depth": d, "class": c,
+                    "flops": est.flops, "hbm_bytes": est.hbm_bytes,
+                    "serial_steps": est.serial_steps,
+                    "predicted_s": s,
+                    "predicted_cycles": s * prof.clock_hz,
+                    "verdict": verdict,
+                })
+    for cap, band in ALIGN_BUCKETS:
+        est = align_job_cost(cap, band, "xla")
+        s, verdict = roofline(est, prof)
+        rows.append({
+            "kind": "align", "tier": "xla", "cap": cap, "band": band,
+            "flops": est.flops, "hbm_bytes": est.hbm_bytes,
+            "serial_steps": est.serial_steps,
+            "predicted_s": s, "predicted_cycles": s * prof.clock_hz,
+            "verdict": verdict,
+        })
+    return rows
+
+
+# -- validation against a measured trace ----------------------------------
+
+_POA_CELLS = re.compile(r"^poa\.cells\.d(\d+)\.c(\d+)$")
+_POA_WINDOWS = re.compile(r"^poa\.windows\.d(\d+)\.c(\d+)$")
+_ALIGN_CELLS = re.compile(r"^align\.cells\.c(\d+)$")
+
+#: Trace phase span name -> run-report phase name (bench.py's
+#: `phase_wall` keys use the report names).
+PHASE_ALIASES = {"align": "alignment", "poa": "consensus"}
+
+
+def _err_pct(pred: float, meas: float) -> Optional[float]:
+    if meas <= 0.0:
+        return None
+    return 100.0 * (pred - meas) / meas
+
+
+def _ratio(pred: float, meas: float) -> Optional[float]:
+    if pred <= 0.0 or meas <= 0.0:
+        return None
+    return max(pred / meas, meas / pred)
+
+
+def _dominant_tier(counters: Dict[str, int], phase: str,
+                   candidates) -> Optional[str]:
+    best, best_n = None, 0
+    for t in candidates:
+        n = counters.get(f"served.{phase}.{t}", 0)
+        if n > best_n:
+            best, best_n = t, n
+    return best
+
+
+def predict_from_counters(counters: Dict[str, int],
+                          prof: MachineProfile) -> dict:
+    """Turn the measured-cell counters (the drivers count them per
+    bucket, see docs/observability.md) into predicted per-phase walls
+    plus a per-bucket table.
+
+    POA: `poa.cells.d<D>.c<C>` = sum over the bucket's windows of
+    (admitted depth x class C) — the serial-step count at graph growth 1.
+    Aligner: `align.cells.c<CAP>` = padded cap x band DP cells per xla
+    bucket, `align.cells.hirschberg` likewise, `align.cells.total` the
+    need-band cells over ALL phase-1 jobs (host share included).
+    """
+    # ---- consensus / POA
+    tier = _dominant_tier(counters, "consensus", POA_TIERS) or "v2"
+    total_served = sum(v for k, v in counters.items()
+                       if k.startswith("served.consensus."))
+    host_served = counters.get("served.consensus.host", 0)
+    host_frac = host_served / total_served if total_served else 0.0
+    buckets = []
+    poa_est = ZERO
+    poa_host_cells = 0.0
+    for name, raw in sorted(counters.items()):
+        m = _POA_CELLS.match(name)
+        if not m:
+            continue
+        d, c = int(m.group(1)), int(m.group(2))
+        steps1 = float(raw)                      # sum(depth_i) * C
+        ranks_steps = steps1 * NODE_GROWTH       # rank-loop steps
+        cells = ranks_steps * c                  # DP cells
+        est = CostEstimate(cells * POA_FLOPS_PER_CELL,
+                           steps1 * POA_LAYER_BYTES,
+                           ranks_steps / (LS_GROUP if tier == "ls"
+                                          else 1.0))
+        dev_share = 1.0 - host_frac
+        dev_est = est.scaled(dev_share)
+        sec, verdict = roofline(dev_est, prof)
+        sec += host_poa_seconds(cells * host_frac, prof)
+        windows = counters.get(f"poa.windows.d{d}.c{c}")
+        buckets.append({"kind": "poa", "tier": tier, "depth": d,
+                        "class": c, "windows": windows,
+                        "cells": cells, "serial_steps": est.serial_steps,
+                        "predicted_s": sec, "verdict": verdict})
+        poa_est = poa_est.plus(dev_est)
+        poa_host_cells += cells * host_frac
+    poa_s, poa_verdict = roofline(poa_est, prof)
+    poa_s += host_poa_seconds(poa_host_cells, prof)
+
+    # ---- alignment
+    a_est = ZERO
+    dev_cells = 0.0
+    for name, raw in sorted(counters.items()):
+        m = _ALIGN_CELLS.match(name)
+        if m:
+            cap = int(m.group(1))
+            band = dict(ALIGN_BUCKETS).get(cap, cap // 4)
+            jobs = max(1, raw // (cap * band))
+            est = align_job_cost(cap, band, "xla").scaled(jobs)
+            a_est = a_est.plus(est)
+            dev_cells += float(raw)
+            sec, verdict = roofline(est, prof)
+            buckets.append({"kind": "align", "tier": "xla", "cap": cap,
+                            "band": band, "cells": float(raw),
+                            "predicted_s": sec, "verdict": verdict})
+    hs_cells = counters.get("align.cells.hirschberg", 0)
+    if hs_cells:
+        est = CostEstimate(hs_cells * ALIGN_FLOPS_PER_CELL,
+                           hs_cells * 0.1, hs_cells * 4.0 / 256.0)
+        a_est = a_est.plus(est)
+        dev_cells += float(hs_cells)
+        sec, verdict = roofline(est, prof)
+        buckets.append({"kind": "align", "tier": "hirschberg",
+                        "cells": float(hs_cells), "predicted_s": sec,
+                        "verdict": verdict})
+    align_s, align_verdict = roofline(a_est, prof)
+    # the host aligner serves whatever the device buckets did not cover
+    total_cells = counters.get("align.cells.total", 0)
+    host_cells = max(0.0, float(total_cells) - dev_cells)
+    align_s += host_align_seconds(host_cells, prof)
+    if host_cells and host_cells >= dev_cells:
+        align_verdict = "host-served"
+
+    return {
+        "buckets": buckets,
+        "phases": {
+            "poa": {"predicted_s": poa_s, "verdict": poa_verdict,
+                    "tier": tier},
+            "align": {"predicted_s": align_s, "verdict": align_verdict},
+        },
+    }
+
+
+def _bucket_walls_us(doc: dict) -> Dict[tuple, float]:
+    """Measured submit-side wall per (kind, key) from the bucket/cohort
+    spans.  Pipelined drains can land inside a neighboring bucket's span
+    (documented in docs/observability.md), so these are first-order."""
+    walls: Dict[tuple, float] = {}
+    for ev in doc.get("traceEvents", []):
+        if not (isinstance(ev, dict) and ev.get("ph") == "X"):
+            continue
+        args = ev.get("args") or {}
+        if ev.get("name") == "poa.bucket":
+            key = ("poa", int(args.get("depth", -1)),
+                   int(args.get("wl_class", -1)))
+        elif ev.get("name") == "align.cohort":
+            key = ("align", args.get("tier", "?"),
+                   int(args.get("cap", 0) or 0))
+        else:
+            continue
+        walls[key] = walls.get(key, 0.0) + float(ev.get("dur", 0))
+    return walls
+
+
+def validate_trace(doc: dict, prof: MachineProfile) -> dict:
+    """Join predictions against a measured trace.
+
+    Returns {profile, phases: {name: {predicted_s, measured_s,
+    error_pct, ratio, within_bound}}, buckets: [...], dropped_events,
+    ok}.  Only the modeled phases (align, poa) gate `ok`; a phase with
+    no measured wall or no counted cells is reported but not gated.
+    """
+    metrics = (doc.get("racon_tpu") or {}).get("metrics") or {}
+    counters = metrics.get("counters") or {}
+    pred = predict_from_counters(counters, prof)
+
+    measured: Dict[str, float] = {}
+    for ev in doc.get("traceEvents", []):
+        if isinstance(ev, dict) and ev.get("ph") == "X" \
+                and isinstance(ev.get("name"), str) \
+                and ev["name"].startswith("phase."):
+            p = ev["name"][len("phase."):]
+            measured[p] = measured.get(p, 0.0) + ev.get("dur", 0) / 1e6
+
+    phases = {}
+    ok = True
+    for name, row in pred["phases"].items():
+        meas = measured.get(name)
+        p_s = row["predicted_s"]
+        entry = dict(row, measured_s=meas)
+        if meas is not None and p_s > 0.0:
+            entry["error_pct"] = _err_pct(p_s, meas)
+            r = _ratio(p_s, meas)
+            entry["ratio"] = r
+            within = r is not None and r <= prof.error_bound_ratio
+            entry["within_bound"] = within
+            ok = ok and within
+        else:
+            entry["within_bound"] = None   # nothing to gate on
+        phases[name] = entry
+
+    # join per-bucket predictions against the bucket/cohort span walls
+    bwalls = _bucket_walls_us(doc)
+    for b in pred["buckets"]:
+        if b["kind"] == "poa":
+            key = ("poa", b["depth"], b["class"])
+        else:
+            key = ("align", b["tier"], b.get("cap", 0))
+        us = bwalls.get(key)
+        if us is not None:
+            b["measured_s"] = us / 1e6
+            b["error_pct"] = _err_pct(b["predicted_s"], us / 1e6)
+
+    dropped = (doc.get("otherData") or {}).get("dropped_events", 0)
+    return {
+        "profile": prof.name,
+        "error_bound_ratio": prof.error_bound_ratio,
+        "phases": phases,
+        "buckets": pred["buckets"],
+        "dropped_events": dropped,
+        "ok": ok,
+    }
+
+
+# -- bench.py integration --------------------------------------------------
+
+def bench_cost_model(snapshot: Optional[dict], phase_wall: Dict[str, float],
+                     profile_name: str = "auto",
+                     platform: Optional[str] = None) -> Optional[dict]:
+    """The `cost_model` stamp for a bench JSON entry: predicted vs
+    measured per modeled phase, error %%, and the profile used.  Returns
+    None when the run collected no metrics (cost model disarmed)."""
+    if not snapshot or not isinstance(snapshot.get("counters"), dict):
+        return None
+    prof = resolve_profile(profile_name, platform)
+    pred = predict_from_counters(snapshot["counters"], prof)
+    out = {"profile": prof.name, "phases": {}}
+    ok = True
+    for span_name, row in pred["phases"].items():
+        report_name = PHASE_ALIASES.get(span_name, span_name)
+        meas = phase_wall.get(report_name)
+        p_s = row["predicted_s"]
+        entry = {"predicted_s": round(p_s, 4),
+                 "measured_s": meas,
+                 "verdict": row["verdict"]}
+        if meas and p_s > 0.0:
+            entry["error_pct"] = round(_err_pct(p_s, meas), 1)
+            r = _ratio(p_s, meas)
+            entry["within_bound"] = (r is not None
+                                     and r <= prof.error_bound_ratio)
+            ok = ok and entry["within_bound"]
+        out["phases"][report_name] = entry
+    out["ok"] = ok
+    return out
+
+
+# -- rendering -------------------------------------------------------------
+
+def _fmt_si(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v == 0:
+        return "0"
+    mag = int(math.floor(math.log10(abs(v)) / 3)) if v else 0
+    mag = max(0, min(mag, 4))
+    return f"{v / 1000 ** mag:.3g}{('', 'k', 'M', 'G', 'T')[mag]}"
+
+
+def render_model(rows: List[dict], prof: MachineProfile) -> str:
+    lines = [f"machine profile: {prof.name} "
+             f"(clock {prof.clock_hz / 1e9:.2f} GHz, "
+             f"peak {_fmt_si(prof.peak_flops)}FLOP/s, "
+             f"HBM {_fmt_si(prof.hbm_bytes_per_s)}B/s, "
+             f"serial step {prof.serial_step_s * 1e6:.2f} us)",
+             f"{'kernel':<22s} {'flops':>8s} {'bytes':>8s} "
+             f"{'steps':>8s} {'wall':>10s} {'cycles':>9s}  verdict"]
+    for r in rows:
+        if r["kind"] == "poa":
+            name = f"poa.{r['tier']} d{r['depth']} c{r['class']}"
+        else:
+            name = f"align.{r['tier']} c{r['cap']} b{r['band']}"
+        lines.append(
+            f"{name:<22s} {_fmt_si(r['flops']):>8s} "
+            f"{_fmt_si(r['hbm_bytes']):>8s} "
+            f"{_fmt_si(r['serial_steps']):>8s} "
+            f"{r['predicted_s'] * 1e3:>8.3f}ms "
+            f"{_fmt_si(r['predicted_cycles']):>9s}  {r['verdict']}")
+    return "\n".join(lines)
+
+
+def render_validation(v: dict) -> str:
+    lines = [f"cost-model validation (profile {v['profile']}, "
+             f"declared bound {v['error_bound_ratio']:.1f}x)"]
+    if v["dropped_events"]:
+        lines.append(f"WARNING: trace dropped {v['dropped_events']} "
+                     f"span(s) past the bounded buffer — measured walls "
+                     f"below may be incomplete")
+    lines.append("-- phases " + "-" * 48)
+    for name, row in sorted(v["phases"].items()):
+        meas = row.get("measured_s")
+        err = row.get("error_pct")
+        gate = row.get("within_bound")
+        mark = ("ok" if gate else "PAST BOUND") if gate is not None \
+            else "not gated"
+        lines.append(
+            f"  phase.{name:<10s} predicted {row['predicted_s']:>9.3f}s  "
+            f"measured {'-' if meas is None else f'{meas:9.3f}s'}  "
+            f"err {'-' if err is None else f'{err:+7.1f}%'}  "
+            f"[{row['verdict']}] {mark}")
+    if v["buckets"]:
+        lines.append("-- buckets " + "-" * 47)
+        for b in v["buckets"]:
+            if b["kind"] == "poa":
+                name = f"poa d{b['depth']} c{b['class']}"
+                extra = f" x{b['windows']}" if b.get("windows") else ""
+            else:
+                name = f"align {b['tier']}" + (
+                    f" c{b['cap']}" if b.get("cap") else "")
+                extra = ""
+            meas = b.get("measured_s")
+            err = b.get("error_pct")
+            lines.append(
+                f"  {name:<18s}{extra:<6s} cells {_fmt_si(b['cells']):>7s} "
+                f"pred {b['predicted_s'] * 1e3:>9.2f}ms "
+                f"meas {'-' if meas is None else f'{meas * 1e3:9.2f}ms'} "
+                f"err {'-' if err is None else f'{err:+6.0f}%'} "
+                f"[{b['verdict']}]")
+    verdict = "OK" if v["ok"] else "PREDICTION ERROR PAST DECLARED BOUND"
+    lines.append(f"verdict: {verdict}")
+    return "\n".join(lines)

@@ -48,6 +48,32 @@ class Histogram:
                 "buckets": dict(self.buckets)}
 
 
+def hist_quantile(hist: dict, q: float):
+    """Approximate quantile from a snapshotted log2 histogram dict
+    (``Histogram.as_dict()`` shape): the *upper bound* of the bucket
+    where the cumulative count crosses ``q`` — exact to within one log2
+    bucket, which is all the breakdown's p50/p99 columns promise.
+    Returns None for an empty/malformed histogram."""
+    try:
+        total = int(hist["count"])
+        buckets = hist["buckets"]
+    except (KeyError, TypeError, ValueError):
+        return None
+    if total <= 0 or not isinstance(buckets, dict) or not buckets:
+        return None
+    need = max(1, math.ceil(q * total))
+    seen = 0
+    for bound in sorted(buckets, key=float):
+        seen += int(buckets[bound])
+        if seen >= need:
+            # the top bucket's true upper bound is the observed max
+            if hist.get("max") is not None:
+                return min(float(bound), float(hist["max"])) \
+                    if float(bound) else 0.0
+            return float(bound)
+    return hist.get("max")
+
+
 class Metrics:
     """Thread-safe registry.  Counter and histogram namespaces are
     disjoint by convention (a name is one or the other)."""

@@ -87,6 +87,10 @@ class Tracer:
         self._thread_names = {}   # tid -> python thread name ("M" events)
         self.dropped = 0
         self._max = max_events
+        #: Optional ``(name, dur_us) -> None`` callback fired for every
+        #: complete event — even past the buffer cap, so the span_us.*
+        #: duration histograms stay exact when the timeline is truncated.
+        self.on_complete = None
         # Event timestamps are offsets from tracer creation so traces
         # start near ts=0 regardless of the monotonic clock's epoch.
         self._t0 = time.monotonic_ns()
@@ -113,10 +117,13 @@ class Tracer:
         Exposed directly (not only via Span) so call sites that detect an
         interesting region *after the fact* — e.g. a kernel-cache miss —
         can stamp it retroactively."""
+        dur = max(0, (t1_ns - t0_ns) // 1000)
         self._append({"name": name, "cat": cat, "ph": "X",
-                      "ts": self._ts_us(t0_ns),
-                      "dur": max(0, (t1_ns - t0_ns) // 1000),
+                      "ts": self._ts_us(t0_ns), "dur": dur,
                       "args": args})
+        cb = self.on_complete
+        if cb is not None:
+            cb(name, dur)
 
     def add_instant(self, name: str, cat: str = "event", **args) -> None:
         """Record a point event (lattice demotion, watchdog timeout, …)."""
@@ -128,7 +135,8 @@ class Tracer:
         with self._lock:
             return list(self._events)
 
-    def to_dict(self, metrics: Optional[dict] = None) -> dict:
+    def to_dict(self, metrics: Optional[dict] = None,
+                platform: Optional[str] = None) -> dict:
         """The full Chrome-trace JSON object.  Extra top-level keys are
         ignored by Perfetto, so the metrics snapshot and provenance ride
         along in the same file the timeline lives in."""
@@ -145,13 +153,18 @@ class Tracer:
             "otherData": {"tool": "racon_tpu.obs", "clock": "monotonic",
                           "dropped_events": dropped},
         }
+        if platform:
+            # lets `obs validate --profile auto` pick the right machine
+            # profile without re-importing the backend
+            doc["otherData"]["platform"] = platform
         if metrics is not None:
             doc["racon_tpu"] = {"metrics": metrics}
         return doc
 
-    def write(self, path: str, metrics: Optional[dict] = None) -> None:
+    def write(self, path: str, metrics: Optional[dict] = None,
+              platform: Optional[str] = None) -> None:
         tmp = f"{path}.tmp.{self.pid}"
         with open(tmp, "w") as f:
-            json.dump(self.to_dict(metrics), f)
+            json.dump(self.to_dict(metrics, platform=platform), f)
             f.write("\n")
         os.replace(tmp, path)

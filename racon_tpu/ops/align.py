@@ -174,6 +174,9 @@ def run_jobs(pipeline, jobs, batch: int = 16, report=None,
     for (cap, band), items in sorted(grouped.items()):
         kernel = build_align_kernel(cap, band)
         obs.count(f"align.bucket.c{cap}", len(items))
+        # Measured-cell counter for the cost model (obs/costmodel.py):
+        # every job in a bucket pays the full padded cap x band DP.
+        obs.count(f"align.cells.c{cap}", len(items) * cap * band)
         for off in range(0, len(items), batch):
             chunk = items[off:off + batch]
 

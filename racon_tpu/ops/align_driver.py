@@ -76,6 +76,17 @@ def run_alignment_phase(pipeline, progress: bool = False,
                                  "align_driver.run_alignment_phase")
     n = pipeline.num_align_jobs()
     report.total = n
+    if n and obs.enabled() and hasattr(pipeline, "align_job_lengths"):
+        # Total need-band DP cells over ALL phase-1 jobs (host share
+        # included) for the cost model (obs/costmodel.py): per pair,
+        # max(n, m) rows x the 10%-rule band the aligner actually needs.
+        import numpy as np
+
+        L = np.asarray(pipeline.align_job_lengths(), dtype=np.int64)[:n]
+        if L.size:
+            mx = L.max(axis=1)
+            need = np.abs(L[:, 1] - L[:, 0]) + mx // 10 + 2
+            obs.count("align.cells.total", int((mx * need).sum()))
     replayed = replay_cigars(pipeline, journal, n, report)
     if n:
         # engine resolution inside the guard AND the try: with no align

@@ -566,8 +566,19 @@ def run_jobs(pipeline, jobs, cohort: int = None, report=None,
     if cohort is None:
         cohort = cohort_size()
     served = 0
+    lengths = (pipeline.align_job_lengths()
+               if obs.enabled() and hasattr(pipeline, "align_job_lengths")
+               else None)
     for off in range(0, len(jobs), cohort):
         group = jobs[off:off + cohort]
+        if lengths is not None:
+            # Measured-cell counter for the cost model (obs/costmodel.py):
+            # forward+backward distance passes over the recursion tree
+            # ~ 2x the base max(n,m) x band DP.
+            obs.count("align.cells.hirschberg", sum(
+                2 * max(int(lengths[j, 0]), int(lengths[j, 1]))
+                * band_for(int(lengths[j, 0]), int(lengths[j, 1]))
+                for j in group))
 
         def attempt(sub):
             faults.check("align.run", sub)

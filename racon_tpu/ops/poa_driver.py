@@ -260,6 +260,13 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
         for (depth_bucket, wl_class), bucket_jobs in sorted(buckets.items()):
             obs.count(f"poa.windows.d{depth_bucket}.c{wl_class}",
                       len(bucket_jobs))
+            # Measured-cell counter for the cost model (obs/costmodel.py):
+            # sum of (admitted depth x class) over the bucket's windows —
+            # the serial-step count at graph growth 1.  True depth, not
+            # the bucket cap: padding layers are all-zero rows the model
+            # must not bill as DP work.
+            obs.count(f"poa.cells.d{depth_bucket}.c{wl_class}",
+                      sum(d for _, d, _ in bucket_jobs) * wl_class)
             obs.observe("poa.bucket_windows", len(bucket_jobs))
             # Bucket spans cover submit-side work; with pipelining a
             # chunk of bucket X may *drain* inside bucket Y's span — the
@@ -583,9 +590,17 @@ def _build_kernel(cfg, B, use_pallas, kind: str = "v2"):
     built = _build_kernel_cached(cfg, B, use_pallas, kind, _n_devices(),
                                  _platform())
     if _build_kernel_cached.cache_info().misses != misses0:
+        from . import cost_hooks
+
+        # predicted per-window bill for this geometry/tier, stamped next
+        # to the measured build wall (obs/costmodel.py)
+        pred = cost_hooks.record_build(
+            "build_lockstep_poa_kernel" if kind == "ls"
+            else "build_pallas_poa_kernel" if kind == "v2"
+            else "build_poa_kernel", (cfg,), {})
         obs.add_complete("kernel.build", t0, time.monotonic_ns(),
                          builder=f"poa.{kind}", B=B,
-                         max_nodes=cfg.max_nodes, depth=cfg.depth)
+                         max_nodes=cfg.max_nodes, depth=cfg.depth, **pred)
         obs.count(f"kernel.builds.poa.{kind}")
     return built
 

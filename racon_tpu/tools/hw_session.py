@@ -27,7 +27,12 @@ case:
 * **partial-session report** — ``docs/hw_session_report.json`` lists
   every step's outcome (ok / failed / timeout / cached / skipped),
   attempts, and wall time; a summary line also lands in the durable
-  ``docs/hw_session_log.jsonl`` evidence trail.
+  ``docs/hw_session_log.jsonl`` evidence trail;
+* **cost-model validation** — each step's obs trace is joined against
+  the analytic cost model (``python -m racon_tpu.obs validate``) in a
+  bounded subprocess before the trace is discarded, so every measured
+  session doubles as a prediction-accuracy data point
+  (``cost_model`` in the step entry).
 
 Priorities (unchanged):
 
@@ -187,9 +192,34 @@ def _trace_phase_walls(path):
         return {}
 
 
+def _trace_cost_validation(trace_path, cwd, timeout_s=120):
+    """Predicted-vs-measured cost-model join for a step's trace, run
+    through the obs CLI in a bounded subprocess (this orchestrator
+    imports nothing from the package, and a broken package must not
+    break the session).  Returns the validation dict with the CLI exit
+    code attached, or None when the step wrote no trace or the CLI
+    failed/hung — evidence enrichment, never a step failure."""
+    if not os.path.exists(trace_path):
+        return None
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "racon_tpu.obs", "validate", "--json",
+             trace_path],
+            cwd=cwd, capture_output=True, text=True, timeout=timeout_s)
+        v = json.loads(r.stdout)
+        if not isinstance(v, dict):
+            return None
+        v["exit_code"] = r.returncode
+        return v
+    except (subprocess.TimeoutExpired, subprocess.SubprocessError,
+            ValueError, OSError):
+        return None
+
+
 def _attempt(name, cmd, bound_s, env, cwd):
     """One bounded attempt.  Returns (outcome, tail, report|None,
-    phase_walls) with outcome in {'ok', 'failed', 'timeout'}."""
+    phase_walls, cost_model|None) with outcome in
+    {'ok', 'failed', 'timeout'}."""
     # every polish inside the step writes its resilience run report here
     # (last polish wins); read back into the durable log entry so a
     # silently degraded tier is visible in the evidence trail
@@ -234,12 +264,15 @@ def _attempt(name, cmd, bound_s, env, cwd):
     except (OSError, ValueError):
         pass  # step ran no polish (probe/pins) or died before writing
     phase_walls = _trace_phase_walls(env["RACON_TPU_TRACE"])
+    # cost-model validation rides the same trace before it is discarded:
+    # every measured session doubles as a prediction-accuracy data point
+    cost_model = _trace_cost_validation(env["RACON_TPU_TRACE"], cwd)
     if env["RACON_TPU_TRACE"] == trace_path:
         try:
             os.remove(trace_path)
         except OSError:
             pass
-    return outcome, tail, report, phase_walls
+    return outcome, tail, report, phase_walls, cost_model
 
 
 def run_step(name, cmd, bound_s, extra_env, retries=1, backoff_s=10.0,
@@ -255,11 +288,12 @@ def run_step(name, cmd, bound_s, extra_env, retries=1, backoff_s=10.0,
     # monotonic: elapsed/backoff accounting must not jump with NTP steps
     t0 = time.monotonic()
     attempts = 0
-    outcome, tail, report, phase_walls = "failed", "", None, {}
+    outcome, tail, report, phase_walls, cost_model = \
+        "failed", "", None, {}, None
     for k in range(retries + 1):
         attempts += 1
-        outcome, tail, report, phase_walls = _attempt(name, cmd, bound_s,
-                                                      env, cwd)
+        outcome, tail, report, phase_walls, cost_model = _attempt(
+            name, cmd, bound_s, env, cwd)
         if outcome != "failed" or k == retries:
             break
         # exponential backoff + jitter: give a flapping tunnel room to
@@ -279,6 +313,8 @@ def run_step(name, cmd, bound_s, extra_env, retries=1, backoff_s=10.0,
         entry["report"] = report
     if phase_walls:
         entry["phase_wall"] = phase_walls
+    if cost_model is not None:
+        entry["cost_model"] = cost_model
     return entry
 
 

@@ -312,3 +312,82 @@ def test_cli_diff_json_output(tmp_path, capsys):
     assert obs_cli.main(["--diff", "--json", str(old), str(new)]) == 3
     out = json.loads(capsys.readouterr().out)
     assert any("phase.poa" in r for r in out["regressions"])
+
+
+def test_cli_diff_one_sided_phase_is_flagged_not_crashed(tmp_path, capsys):
+    """Satellite: a phase present on only one side (a resumed run that
+    replayed align from the journal has no phase.align span) is flagged
+    only-in-old/new with the missing side counted as 0 — previously
+    infinite-percent material."""
+    both = tmp_path / "both.json"
+    both.write_text(json.dumps(_trace_doc(10_000)))
+    doc = _trace_doc(10_000)
+    doc["traceEvents"].append({"name": "phase.align", "ph": "X", "ts": 0,
+                               "dur": 50_000, "pid": 1, "tid": 1})
+    extra = tmp_path / "extra.json"
+    extra.write_text(json.dumps(doc))
+    # phase only in OLD: not a regression (new side is 0), just a note
+    assert obs_cli.main(["--diff", str(extra), str(both)]) == 0
+    out = capsys.readouterr().out
+    assert "only-in-old" in out and "phase.align" in out
+    # phase only in NEW past min-delta: flagged AND gated as a regression
+    assert obs_cli.main(["--diff", str(both), str(extra)]) == 3
+    out = capsys.readouterr().out
+    assert "only-in-new" in out
+    assert obs_cli.main(["--diff", "--json", str(both), str(extra)]) == 3
+    j = json.loads(capsys.readouterr().out)
+    assert any("only-in-new" in f for f in j["only_in"])
+    assert any("only-in-new" in r for r in j["regressions"])
+    # under min-delta the structural note stays but nothing gates
+    assert obs_cli.main(["--diff", str(both), str(extra),
+                         "--min-delta-us", "60000"]) == 0
+
+
+def test_cli_validate_reports_dropped_events(tmp_path, capsys):
+    doc = _trace_doc(5000)
+    doc["otherData"] = {"dropped_events": 12}
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(doc))
+    assert obs_cli.main(["--validate", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "12 event(s)" in out and "truncated" in out
+    assert obs_cli.main(["--validate", "--json", str(path)]) == 0
+    assert json.loads(capsys.readouterr().out)["dropped_events"] == 12
+    # the breakdown warns too
+    assert obs_cli.main([str(path)]) == 0
+    assert "dropped" in capsys.readouterr().out
+
+
+# ------------------------------- e2e: span quantiles + cell counters
+
+def test_traced_polish_span_quantiles_and_cost_counters(tmp_path,
+                                                        monkeypatch):
+    """The on_complete callback feeds span_us.* histograms for every
+    finished span (buffer-dropped ones included), the drivers count the
+    measured DP cells the cost model predicts against, and the platform
+    provenance stamp lands in otherData."""
+    paths = _write_dataset(tmp_path)
+    trace = tmp_path / "q_trace.json"
+    res, _ = _tpu_run(paths, monkeypatch,
+                      {"RACON_TPU_DEVICE_ALIGNER": "1"},
+                      trace_path=str(trace))
+    assert res
+    doc, errors = obs_cli.load_trace(str(trace))
+    assert errors == []
+    q = obs_cli.span_quantiles(doc)
+    for phase in obs.PHASES:
+        name = f"phase.{phase}"
+        assert name in q, (name, sorted(q))
+        assert q[name]["count"] >= 1
+        assert 0 <= q[name]["p50_us"] <= q[name]["p99_us"]
+    assert "span durations" in obs_cli.render(doc, str(trace))
+    counters = doc["racon_tpu"]["metrics"]["counters"]
+    assert any(k.startswith("poa.cells.d") for k in counters), counters
+    assert "align.cells.total" in counters
+    assert doc["otherData"]["platform"] == "cpu"
+    # the measured-cell counters drive a structurally complete validation
+    from racon_tpu.obs import costmodel
+    v = costmodel.validate_trace(doc, costmodel.PROFILES["cpu-host"])
+    assert set(v["phases"]) == {"poa", "align"}
+    assert v["phases"]["poa"]["predicted_s"] > 0.0
+    assert any(b["kind"] == "poa" for b in v["buckets"])

@@ -315,5 +315,37 @@ def test_bench_degraded_result_is_null_not_zero():
     assert e["vs_baseline"] is None
     assert e["device_status"] == "unreachable"
     assert "TPU UNREACHABLE" in e["metric"]
+    assert e["cost_model"] is None       # explicit: no prediction joined
     # round-trips through the reader unchanged
     assert bench.normalize_entry(json.loads(json.dumps(e))) == e
+
+
+def test_bench_normalize_entry_malformed_partial_summaries():
+    """The committed log is hand-editable and spans writer generations:
+    backfill must cope with entries missing BOTH phase_wall and report,
+    and with report summaries whose tier walls are partial/absent."""
+    import bench
+    # neither phase_wall nor report: no phase_wall invented, cost_model
+    # backfills null
+    bare = bench.normalize_entry({"value": 0.01})
+    assert "phase_wall" not in bare and bare["cost_model"] is None
+    # report present but not a dict / summary rows without wall_s: only
+    # the well-formed rows yield a backfilled wall
+    assert "phase_wall" not in bench.normalize_entry(
+        {"value": 0.01, "report": "corrupt"})
+    mixed = bench.normalize_entry({"value": 0.01, "report": {
+        "alignment": {"served": {"xla": 5}},            # wall_s absent
+        "consensus": {"wall_s": {"v2": 1.5, "host": 0.5}},
+        "stitch": {"wall_s": "not-a-dict"},
+        "parse": 3.0,                                    # not even a dict
+    }})
+    assert mixed["phase_wall"] == {"consensus": 2.0}
+    # an explicit stamp (even {}) is the writer's claim: never overwritten
+    stamped = bench.normalize_entry(
+        {"value": 0.01, "phase_wall": {},
+         "report": {"consensus": {"wall_s": {"v2": 1.0}}}})
+    assert stamped["phase_wall"] == {}
+    # an existing cost_model stamp survives untouched
+    cm = {"profile": "cpu-host", "phases": {}, "ok": True}
+    assert bench.normalize_entry(
+        {"value": 0.01, "cost_model": cm})["cost_model"] == cm
