@@ -83,38 +83,12 @@ def dataset(mbp: float = MBP):
 
 
 def observed_window_lengths(draft_path: str, w: int) -> set:
-    """Every window length the consensus phase will actually derive.
+    """Every window length the consensus phase will actually derive —
+    now shared with the pipelined polisher's warm-up thread, so the one
+    implementation lives next to warm_geometries (ops/poa_driver.py)."""
+    from racon_tpu.ops.poa_driver import observed_window_lengths as owl
 
-    run_consensus_phase buckets kernel geometry by the OBSERVED backbone
-    classes, not the nominal -w (poa_driver.py metadata pass). Windows
-    are fixed-size chunks of draft contigs (rt_pipeline.cpp window
-    build), so the set is computable from the draft FASTA alone: per
-    contig, w for the full chunks plus the tail remainder. Warming only
-    the nominal w would leave the tail-class geometries to compile
-    inside the timed pass."""
-    lens = set()
-
-    def add(contig_len):
-        if contig_len <= 0:
-            return
-        if contig_len >= w:
-            lens.add(w)
-        rem = contig_len % w
-        if contig_len < w:
-            lens.add(contig_len)
-        elif rem:
-            lens.add(rem)
-
-    cur = 0
-    with open(draft_path) as f:
-        for line in f:
-            if line.startswith(">"):
-                add(cur)
-                cur = 0
-            else:
-                cur += len(line.strip())
-    add(cur)
-    return lens or {1}
+    return owl(draft_path, w)
 
 
 def _forced_device() -> bool:
@@ -311,6 +285,23 @@ def phase_wall(report_summary) -> dict:
     return out
 
 
+def pack_split(report_summary) -> dict:
+    """Per-phase host-pack vs kernel wall split from a RunReport.summary()
+    dict — the shared executor (racon_tpu/ops/batch_exec.py) stamps
+    `pack_wall_s` / `kernel_wall_s` into each phase's extras.  VERDICT
+    #7's feeder criterion (pack time < kernel time) is checkable from
+    this stamp alone.  Entries predating the executor yield {}."""
+    out = {}
+    if isinstance(report_summary, dict):
+        for phase, rep in report_summary.items():
+            ex = rep.get("extra") if isinstance(rep, dict) else None
+            if isinstance(ex, dict) and ("pack_wall_s" in ex
+                                         or "kernel_wall_s" in ex):
+                out[phase] = {"pack_wall_s": ex.get("pack_wall_s"),
+                              "kernel_wall_s": ex.get("kernel_wall_s")}
+    return out
+
+
 def normalize_entry(e: dict) -> dict:
     """Reader-side honesty backfill for bench JSON entries/log lines.
 
@@ -341,6 +332,10 @@ def normalize_entry(e: dict) -> dict:
             e = dict(e, phase_wall=pw)
     if "cost_model" not in e:
         e = dict(e, cost_model=None)
+    if "pack_split" not in e:
+        # old logs: recover the split from the embedded report when the
+        # executor stamped it there, else explicit null ("not measured")
+        e = dict(e, pack_split=pack_split(e.get("report")) or None)
     return e
 
 
@@ -357,9 +352,11 @@ def degraded_result(mbps_cpu: float, note: str = "") -> dict:
         "unit": "Mbp/s",
         "vs_baseline": None,
         "device_status": "unreachable",
-        # no device run, no prediction-vs-measured join — explicit null
-        # keeps normalize_entry a fixed point on fresh entries
+        # no device run: no prediction-vs-measured join and no
+        # pack-vs-kernel wall split — explicit nulls keep
+        # normalize_entry a fixed point on fresh entries
         "cost_model": None,
+        "pack_split": None,
     }
 
 
@@ -544,6 +541,7 @@ def main():
         "node_factor": config.get_int("RACON_TPU_NODE_FACTOR"),
         "tpu_s": round(dt_tpu, 1), "cpu_s": round(dt_cpu, 1),
         "report": rep_tpu, "phase_wall": phase_wall(rep_tpu),
+        "pack_split": pack_split(rep_tpu) or None,
         "cost_model": cm,
         **({"sanitize": True} if sanitized else {}),
     })
@@ -554,6 +552,7 @@ def main():
         "unit": "Mbp/s",
         "vs_baseline": round(mbps_tpu / mbps_cpu, 3),
         "report": rep_tpu, "phase_wall": phase_wall(rep_tpu),
+        "pack_split": pack_split(rep_tpu) or None,
         "cost_model": cm,
         **({"sanitize": True} if sanitized else {}),
     }))

@@ -60,6 +60,13 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
        "windows per device batch (default: 64 on TPU, 4 elsewhere)"),
     _k("RACON_TPU_PIPELINE_DEPTH", "2", "int",
        "in-flight device chunks (host packs ahead of execution)"),
+    _k("RACON_TPU_PIPELINE_PHASES", None, "bool",
+       "overlap alignment and consensus across target chunks: POA for "
+       "early contigs starts while late alignment cohorts are in flight "
+       "(multi-contig FASTA targets; output stays byte-identical)"),
+    _k("RACON_TPU_HANDOFF_DEPTH", "1", "int",
+       "phase-pipeline handoff queue depth: aligned target chunks the "
+       "worker may buffer ahead of consensus"),
     _k("RACON_TPU_NODE_FACTOR", "3", "int",
        "POA graph node capacity = factor x window length"),
     _k("RACON_TPU_ALIGN_COHORT", None, "int",

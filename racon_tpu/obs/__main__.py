@@ -155,6 +155,9 @@ def breakdown(doc: dict) -> dict:
                                                      0) + 1
     return {"phase_us": walls, "served": served, "events": events,
             "counters": counters, "span_quantiles": span_quantiles(doc),
+            # pipelined runs overlap phase spans in wall time; phase_us
+            # above sums work time, this records the concurrency
+            "phase_overlap_us": costmodel.phase_overlaps_us(doc),
             "dropped_events": dropped_events(doc)}
 
 
@@ -174,6 +177,22 @@ def render(doc: dict, path: str) -> str:
         lines.append(f"  {p:<16s} {us / 1e3:>10.2f} ms {pct:>5.1f}%")
     if not order:
         lines.append("  (no phase.* spans)")
+    if b["phase_overlap_us"]:
+        # sum(phase_us) counts concurrent time twice; the union wall is
+        # what the clock saw
+        ivs = []
+        for ev in doc.get("traceEvents", []):
+            if isinstance(ev, dict) and ev.get("ph") == "X" \
+                    and isinstance(ev.get("name"), str) \
+                    and ev["name"].startswith("phase."):
+                ts = float(ev.get("ts", 0))
+                ivs.append((ts, ts + float(ev.get("dur", 0))))
+        union = sum(e - s for s, e in costmodel.union_intervals(ivs))
+        lines.append("-- phase overlap (pipelined) " + "-" * 15)
+        for pair, us in sorted(b["phase_overlap_us"].items()):
+            lines.append(f"  {pair:<16s} {us / 1e3:>10.2f} ms concurrent")
+        lines.append(f"  {'union wall':<16s} {union / 1e3:>10.2f} ms "
+                     f"(vs {total / 1e3:.2f} ms summed)")
     if b["served"]:
         lines.append("-- served (windows/jobs per tier) " + "-" * 10)
         for phase, tiers in sorted(b["served"].items()):
@@ -366,6 +385,10 @@ def main(argv=None) -> int:
                    help="schema validation only, no breakdown")
     p.add_argument("--diff", action="store_true",
                    help="compare two traces; exit 3 on phase regression")
+    p.add_argument("--overlap", metavar="NAME_A:NAME_B",
+                   help="assert the two span families overlap in time "
+                        "(e.g. align.cohort:poa.bucket for a pipelined "
+                        "polish); exit 3 when the overlap is zero")
     p.add_argument("--threshold", type=float, default=0.25,
                    help="--diff: relative slowdown tolerated per phase "
                         "(default 0.25 = 25%%)")
@@ -416,6 +439,25 @@ def main(argv=None) -> int:
         return 3 if regressions else 0
 
     doc = docs[0]
+    if args.overlap:
+        if ":" not in args.overlap:
+            print("[obs] --overlap expects NAME_A:NAME_B", file=sys.stderr)
+            return 2
+        name_a, name_b = args.overlap.split(":", 1)
+        ov_us = costmodel.overlap_us(doc, name_a, name_b)
+        n_a = len(costmodel.span_intervals(doc, name_a))
+        n_b = len(costmodel.span_intervals(doc, name_b))
+        if args.as_json:
+            print(json.dumps({"a": name_a, "b": name_b, "spans_a": n_a,
+                              "spans_b": n_b, "overlap_us": ov_us}))
+        elif ov_us > 0:
+            print(f"[obs] OK: {name_a} ({n_a} spans) and {name_b} "
+                  f"({n_b} spans) overlap for {ov_us / 1e3:.2f} ms")
+        else:
+            print(f"[obs] NO OVERLAP: {name_a} ({n_a} spans) and "
+                  f"{name_b} ({n_b} spans) never ran concurrently",
+                  file=sys.stderr)
+        return 0 if ov_us > 0 else 3
     if args.validate:
         dropped = dropped_events(doc)
         if not args.as_json:

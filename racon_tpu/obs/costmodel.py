@@ -475,6 +475,69 @@ def predict_from_counters(counters: Dict[str, int],
     }
 
 
+# -- span-interval math (phase pipelining makes spans overlap) -------------
+
+def span_intervals(doc: dict, name: str) -> List[tuple]:
+    """Sorted [(start_us, end_us)] of every complete event named `name`
+    (exact match) in the trace."""
+    out = []
+    for ev in doc.get("traceEvents", []):
+        if isinstance(ev, dict) and ev.get("ph") == "X" \
+                and ev.get("name") == name:
+            ts = float(ev.get("ts", 0))
+            out.append((ts, ts + float(ev.get("dur", 0))))
+    return sorted(out)
+
+
+def union_intervals(intervals) -> List[tuple]:
+    """Merge possibly-overlapping intervals into disjoint ones."""
+    merged: List[list] = []
+    for s, e in sorted(intervals):
+        if merged and s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([s, e])
+    return [tuple(iv) for iv in merged]
+
+
+def overlap_us(doc: dict, name_a: str, name_b: str) -> float:
+    """Total wall (µs) during which a span named `name_a` and one named
+    `name_b` were simultaneously open — the phase-pipelining evidence
+    (`align.cohort` vs `poa.bucket`: nonzero iff alignment cohorts were
+    in flight while POA buckets dispatched)."""
+    a = union_intervals(span_intervals(doc, name_a))
+    b = union_intervals(span_intervals(doc, name_b))
+    total = 0.0
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def phase_overlaps_us(doc: dict) -> Dict[str, float]:
+    """Nonzero pairwise overlaps between ``phase.*`` span families,
+    keyed ``"a+b"``.  Sequential runs return {} (disjoint phase walls);
+    pipelined runs show ``align+poa`` > 0."""
+    names = sorted({ev["name"] for ev in doc.get("traceEvents", [])
+                    if isinstance(ev, dict) and ev.get("ph") == "X"
+                    and isinstance(ev.get("name"), str)
+                    and ev["name"].startswith("phase.")})
+    out: Dict[str, float] = {}
+    for i, na in enumerate(names):
+        for nb in names[i + 1:]:
+            ov = overlap_us(doc, na, nb)
+            if ov > 0:
+                out[f"{na[len('phase.'):]}+{nb[len('phase.'):]}"] = ov
+    return out
+
+
 def _bucket_walls_us(doc: dict) -> Dict[tuple, float]:
     """Measured submit-side wall per (kind, key) from the bucket/cohort
     spans.  Pipelined drains can land inside a neighboring bucket's span
@@ -546,11 +609,18 @@ def validate_trace(doc: dict, prof: MachineProfile) -> dict:
             b["error_pct"] = _err_pct(b["predicted_s"], us / 1e6)
 
     dropped = (doc.get("otherData") or {}).get("dropped_events", 0)
+    # Pipelined runs overlap phase.align / phase.poa in wall time; the
+    # per-phase measured walls above are summed span durations (work
+    # time), so the prediction join stays valid — the overlap is surfaced
+    # so a reader knows the phases did not execute back to back.
+    overlaps = {k: round(v / 1e6, 6)
+                for k, v in phase_overlaps_us(doc).items()}
     return {
         "profile": prof.name,
         "error_bound_ratio": prof.error_bound_ratio,
         "phases": phases,
         "buckets": pred["buckets"],
+        **({"phase_overlap_s": overlaps} if overlaps else {}),
         "dropped_events": dropped,
         "ok": ok,
     }
@@ -641,6 +711,10 @@ def render_validation(v: dict) -> str:
             f"measured {'-' if meas is None else f'{meas:9.3f}s'}  "
             f"err {'-' if err is None else f'{err:+7.1f}%'}  "
             f"[{row['verdict']}] {mark}")
+    if v.get("phase_overlap_s"):
+        lines.append("-- phase overlap (pipelined run) " + "-" * 25)
+        for k, s in sorted(v["phase_overlap_s"].items()):
+            lines.append(f"  {k:<18s} {s:9.3f}s concurrent")
     if v["buckets"]:
         lines.append("-- buckets " + "-" * 47)
         for b in v["buckets"]:

@@ -132,17 +132,130 @@ def build_align_kernel(cap: int, band: int):
     return jax.jit(jax.vmap(one))
 
 
+class _XlaAlignOps:
+    """Executor hooks (ops/batch_exec.py) for the moves-matrix aligner.
+
+    The jit kernel call is a JAX async dispatch, so the shared executor
+    keeps depth-Q chunks in flight: the host packs chunk N+1 while chunk
+    N executes.  Packing is single-copy — each job's bases land once in
+    the chunk's padded buffers; lattice retries and bisection probes
+    gather rows from the per-job views instead of re-materializing."""
+
+    span_name = "align.cohort"
+    async_dispatch = True
+
+    def __init__(self, pipeline, report, stats, state):
+        self.pipeline = pipeline
+        self.report = report
+        self.stats = stats
+        self.state = state        # {"served": int}
+        self.rows = {}            # job -> (q_row, t_row, n, m)
+        self.dead = False
+
+    def live_tier(self, ctx, kind):
+        return "host" if self.dead else "xla"
+
+    def export(self, ctx, chunk):
+        return list(chunk)
+
+    def pack(self, ctx, chunk):
+        cap = ctx["cap"]
+        B = len(chunk)
+        q = np.zeros((B, cap), dtype=np.uint8)
+        t = np.zeros((B, cap), dtype=np.uint8)
+        n = np.zeros(B, dtype=np.int32)
+        m = np.zeros(B, dtype=np.int32)
+        for bi, job in enumerate(chunk):
+            qa, ta = self.pipeline.align_job(job)
+            q[bi, :len(qa)] = encode(qa)
+            t[bi, :len(ta)] = encode(ta)
+            n[bi] = len(qa)
+            m[bi] = len(ta)
+            self.rows[job] = (q[bi], t[bi], n[bi], m[bi])
+        return q, t, n, m
+
+    def dispatch(self, ctx, kind, packed, chunk):
+        from ..resilience import faults
+
+        faults.check("align.run", chunk)
+        return ctx["kernel"](*packed)
+
+    def attempt(self, ctx, kind, sub):
+        from ..resilience import faults
+
+        faults.check("align.run", sub)
+        q = np.stack([self.rows[j][0] for j in sub])
+        t = np.stack([self.rows[j][1] for j in sub])
+        n = np.asarray([self.rows[j][2] for j in sub], dtype=np.int32)
+        m = np.asarray([self.rows[j][3] for j in sub], dtype=np.int32)
+        return tuple(np.asarray(x) for x in ctx["kernel"](q, t, n, m))
+
+    def unpack(self, ctx, kind, outs):
+        return tuple(np.asarray(x) for x in outs)
+
+    def span_args(self, ctx, chunk, pipelined):
+        return {"cap": ctx["cap"], "jobs": len(chunk)}
+
+    def install(self, ctx, kind, sub, results):
+        from ..analysis import sanitize
+        from ..resilience import faults
+
+        ops, cnt, ok = results
+        if sanitize.enabled():
+            sanitize.check_align_outputs(ops, cnt, ok,
+                                         where="align.run_jobs")
+        for bi, job in enumerate(sub):
+            if not ok[bi]:
+                continue  # host will align it
+            faults.check("align.install", (job,))
+            cigar = ops_to_cigar(ops[bi, :cnt[bi]][::-1])
+            self.pipeline.set_job_cigar(job, cigar)
+            self.state["served"] += 1
+            if self.stats is not None:
+                self.stats["device"] = self.stats.get("device", 0) + 1
+            if self.report is not None:
+                self.report.record_served("xla")
+
+    def surrender(self, ctx, items, exported):
+        pass  # CIGAR-less jobs fall to the native host pass
+
+    def quarantine(self, ctx, job, exc):
+        if self.report is not None:
+            self.report.record_quarantine(job, exc)
+
+    def demote(self, ctx, kind, cause):
+        import sys
+
+        self.dead = True
+        print(f"[racon_tpu::align] WARNING: xla aligner failed "
+              f"({type(cause).__name__}: {cause}); remaining jobs "
+              f"fall back to the host aligner", file=sys.stderr)
+        if self.report is not None:
+            self.report.record_degrade("xla", "host", cause)
+        return "host"
+
+    def done(self, ctx, chunk):
+        # keep host memory O(depth x batch): rows die with the chunk
+        for job in chunk:
+            self.rows.pop(job, None)
+
+
 def run_jobs(pipeline, jobs, batch: int = 16, report=None,
-             stats=None) -> int:
+             stats=None, lengths=None) -> int:
     """Align the given pipeline jobs on device; install CIGARs.
     Returns how many alignments the device served.
 
-    Jobs bucket by padded length (lengths only — bases are materialized
-    per chunk inside the device attempt), and every chunk runs through
-    the degradation lattice: bounded retry, then bisection so a poisoned
-    job is quarantined to the host while the rest of the chunk stays on
-    the device.  A chunk-independent failure stops the engine; the served
-    count stays accurate for whatever was already installed.
+    Jobs bucket by padded length (lengths only — bases are packed once
+    per chunk into padded buffers at dispatch time), and every chunk runs
+    through the degradation lattice via the shared executor
+    (ops/batch_exec.py): depth-Q async dispatch, bounded retry, then
+    bisection so a poisoned job is quarantined to the host while the rest
+    of the chunk stays on the device.  A chunk-independent failure stops
+    the engine; the served count stays accurate for whatever was already
+    installed.
+
+    `lengths` is the bulk job-lengths array (the driver fetches it once
+    and threads it through); without it, one bulk fetch happens here.
 
     ``stats`` (the driver's accounting dict) has its ``'device'`` entry
     incremented per installed CIGAR, so even an exception that escapes
@@ -151,14 +264,13 @@ def run_jobs(pipeline, jobs, batch: int = 16, report=None,
     installed (which the driver's host count is derived from)."""
     import sys
 
-    from ..analysis import sanitize
-    from ..resilience import faults
     from ..resilience import lattice as rl
     from .. import obs
+    from .batch_exec import BatchExecutor
 
-    served = 0
-    if hasattr(pipeline, "align_job_lengths"):
+    if lengths is None and hasattr(pipeline, "align_job_lengths"):
         lengths = pipeline.align_job_lengths()
+    if lengths is not None:
         maxlen = {j: int(max(lengths[j, 0], lengths[j, 1])) for j in jobs}
     else:  # duck-typed pipelines without the lengths table
         maxlen = {}
@@ -171,62 +283,32 @@ def run_jobs(pipeline, jobs, batch: int = 16, report=None,
         cap, band = _bucket_for(maxlen[job])
         grouped.setdefault((cap, band), []).append(job)
 
-    for (cap, band), items in sorted(grouped.items()):
-        kernel = build_align_kernel(cap, band)
-        obs.count(f"align.bucket.c{cap}", len(items))
-        # Measured-cell counter for the cost model (obs/costmodel.py):
-        # every job in a bucket pays the full padded cap x band DP.
-        obs.count(f"align.cells.c{cap}", len(items) * cap * band)
-        for off in range(0, len(items), batch):
-            chunk = items[off:off + batch]
-
-            def attempt(sub, _kernel=kernel, _cap=cap):
-                faults.check("align.run", sub)
-                B = len(sub)
-                q = np.zeros((B, _cap), dtype=np.uint8)
-                t = np.zeros((B, _cap), dtype=np.uint8)
-                n = np.zeros(B, dtype=np.int32)
-                m = np.zeros(B, dtype=np.int32)
-                for bi, job in enumerate(sub):
-                    qa, ta = pipeline.align_job(job)
-                    q[bi, :len(qa)] = encode(qa)
-                    t[bi, :len(ta)] = encode(ta)
-                    n[bi] = len(qa)
-                    m[bi] = len(ta)
-                return tuple(np.asarray(x) for x in _kernel(q, t, n, m))
-
-            try:
-                with obs.span("align.cohort", tier="xla", cap=cap,
-                              jobs=len(chunk)):
-                    pairs_results, quarantined = rl.serve_with_bisect(
-                        chunk, attempt, tier="xla", report=report)
-                for sub, (ops, cnt, ok) in pairs_results:
-                    if sanitize.enabled():
-                        sanitize.check_align_outputs(
-                            ops, cnt, ok, where="align.run_jobs")
-                    for bi, job in enumerate(sub):
-                        if not ok[bi]:
-                            continue  # host will align it
-                        faults.check("align.install", (job,))
-                        cigar = ops_to_cigar(ops[bi, :cnt[bi]][::-1])
-                        pipeline.set_job_cigar(job, cigar)
-                        served += 1
-                        if stats is not None:
-                            stats["device"] = stats.get("device", 0) + 1
-                        if report is not None:
-                            report.record_served("xla")
-                for job, exc in quarantined:
-                    if report is not None:
-                        report.record_quarantine(job, exc)
-            except Exception as e:  # noqa: BLE001 — lattice boundary
-                cause = e.cause if isinstance(e, rl.TierDead) else e
-                print(f"[racon_tpu::align] WARNING: xla aligner failed "
-                      f"({type(cause).__name__}: {cause}); remaining jobs "
-                      f"fall back to the host aligner", file=sys.stderr)
-                if report is not None:
-                    report.record_degrade("xla", "host", cause)
-                return served
-    return served
+    state = {"served": 0}
+    ops_obj = _XlaAlignOps(pipeline, report, stats, state)
+    executor = BatchExecutor(ops_obj, report=report)
+    try:
+        for (cap, band), items in sorted(grouped.items()):
+            kernel = build_align_kernel(cap, band)
+            obs.count(f"align.bucket.c{cap}", len(items))
+            # Measured-cell counter for the cost model (obs/costmodel.py):
+            # every job in a bucket pays the full padded cap x band DP.
+            obs.count(f"align.cells.c{cap}", len(items) * cap * band)
+            ctx = {"cap": cap, "band": band, "kernel": kernel}
+            for off in range(0, len(items), batch):
+                executor.submit(ctx, items[off:off + batch])
+            # drain before the next bucket's kernel build so in-flight
+            # futures never outlive their geometry's packed buffers
+            executor.flush()
+    except Exception as e:  # noqa: BLE001 — lattice boundary
+        cause = e.cause if isinstance(e, rl.TierDead) else e
+        print(f"[racon_tpu::align] WARNING: xla aligner failed "
+              f"({type(cause).__name__}: {cause}); remaining jobs "
+              f"fall back to the host aligner", file=sys.stderr)
+        if report is not None:
+            report.record_degrade("xla", "host", cause)
+    if report is not None:
+        executor.stamp_walls(report)
+    return state["served"]
 
 
 _OPC = np.frombuffer(b"MID", dtype=np.uint8)

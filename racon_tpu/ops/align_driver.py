@@ -76,13 +76,18 @@ def run_alignment_phase(pipeline, progress: bool = False,
                                  "align_driver.run_alignment_phase")
     n = pipeline.num_align_jobs()
     report.total = n
-    if n and obs.enabled() and hasattr(pipeline, "align_job_lengths"):
+    # Bulk-FFI lengths array, fetched ONCE and threaded through the cells
+    # counter, per-engine eligibility, and the engines' own bucketing
+    # (each used to refetch it independently).
+    lengths = (pipeline.align_job_lengths()
+               if n and hasattr(pipeline, "align_job_lengths") else None)
+    if lengths is not None and obs.enabled():
         # Total need-band DP cells over ALL phase-1 jobs (host share
         # included) for the cost model (obs/costmodel.py): per pair,
         # max(n, m) rows x the 10%-rule band the aligner actually needs.
         import numpy as np
 
-        L = np.asarray(pipeline.align_job_lengths(), dtype=np.int64)[:n]
+        L = np.asarray(lengths, dtype=np.int64)[:n]
         if L.size:
             mx = L.max(axis=1)
             need = np.abs(L[:, 1] - L[:, 0]) + mx // 10 + 2
@@ -102,10 +107,14 @@ def run_alignment_phase(pipeline, progress: bool = False,
                 faults.check("align.compile")
                 from . import align_pallas
 
-                lengths = pipeline.align_job_lengths()
+                # duck-typed pipelines without the lengths table raise
+                # AttributeError here -> outer catch -> host degrade,
+                # same as the per-engine fetch used to
+                ln = (lengths if lengths is not None
+                      else pipeline.align_job_lengths())
                 jobs = [i for i in range(n) if i not in replayed
-                        and align_pallas.band_for(int(lengths[i, 0]),
-                                                  int(lengths[i, 1])) > 0]
+                        and align_pallas.band_for(int(ln[i, 0]),
+                                                  int(ln[i, 1])) > 0]
                 if jobs:
                     sink = (CigarTap(pipeline, journal, "hirschberg")
                             if journal is not None else pipeline)
@@ -115,19 +124,20 @@ def run_alignment_phase(pipeline, progress: bool = False,
                     # install failure) must not zero the device count —
                     # the host-served figure below is derived from it.
                     align_pallas.run_jobs(sink, jobs, report=report,
-                                          stats=stats)
+                                          stats=stats, lengths=ln)
             else:
                 faults.check("align.compile")
                 from . import align
 
-                lengths = pipeline.align_job_lengths()
+                ln = (lengths if lengths is not None
+                      else pipeline.align_job_lengths())
                 jobs = [i for i in range(n) if i not in replayed
-                        and align.device_eligible(lengths[i, 0],
-                                                  lengths[i, 1])]
+                        and align.device_eligible(ln[i, 0], ln[i, 1])]
                 if jobs:
                     sink = (CigarTap(pipeline, journal, "xla")
                             if journal is not None else pipeline)
-                    align.run_jobs(sink, jobs, report=report, stats=stats)
+                    align.run_jobs(sink, jobs, report=report, stats=stats,
+                                   lengths=ln)
         except Exception as e:  # noqa: BLE001 — engine/backend init
             print(f"[racon_tpu::align] WARNING: device aligner "
                   f"'{engine}' failed ({type(e).__name__}: {e}); "

@@ -541,80 +541,180 @@ def cohort_size(default: int = 64) -> int:
     return max(1, int(env if env is not None else default))
 
 
+class _HirschbergOps:
+    """Executor hooks (ops/batch_exec.py) for the Hirschberg engine.
+
+    The engine is host-orchestrated (align_pairs launches rounds of
+    kernel batches itself), so there is nothing to async-dispatch: each
+    cohort resolves inline through the lattice (`async_dispatch = False`)
+    — bounded retry, bisection-quarantine of a poisoned job, and tier
+    death to host all behave exactly as the pre-executor loop did.
+
+    Single-copy packing: `pack` encodes each job once into two
+    preallocated padded row buffers; the per-job views are what lattice
+    retries and bisection probes reuse (the old loop re-materialized
+    every pair per attempt with a per-job Python loop)."""
+
+    span_name = "align.cohort"
+    async_dispatch = False
+
+    def __init__(self, pipeline, dims, report, stats, state):
+        self.pipeline = pipeline
+        self.dims = dims          # job -> (n, m) from the bulk lengths
+        self.report = report
+        self.stats = stats
+        self.state = state        # {"served": int}
+        self.pairs = {}           # job -> (q_view, t_view), packed once
+        self.dead = False
+
+    def live_tier(self, ctx, kind):
+        return "host" if self.dead else "hirschberg"
+
+    def export(self, ctx, group):
+        return list(group)
+
+    def pack(self, ctx, chunk):
+        qcap = max(1, max(self.dims[j][0] for j in chunk))
+        tcap = max(1, max(self.dims[j][1] for j in chunk))
+        qbuf = np.zeros((len(chunk), qcap), dtype=np.int32)
+        tbuf = np.zeros((len(chunk), tcap), dtype=np.int32)
+        for bi, job in enumerate(chunk):
+            qa, ta = self.pipeline.align_job(job)
+            if len(qa) <= qcap and len(ta) <= tcap:
+                qbuf[bi, :len(qa)] = encode(qa)
+                tbuf[bi, :len(ta)] = encode(ta)
+                self.pairs[job] = (qbuf[bi, :len(qa)], tbuf[bi, :len(ta)])
+            else:
+                # lengths-table mismatch (duck-typed pipeline): fall back
+                # to a standalone copy for just this job
+                self.pairs[job] = (encode(qa).astype(np.int32),
+                                   encode(ta).astype(np.int32))
+        return None
+
+    def attempt(self, ctx, kind, sub):
+        from ..resilience import faults
+
+        faults.check("align.run", sub)
+        return align_pairs([self.pairs[j] for j in sub])
+
+    def span_args(self, ctx, chunk, pipelined):
+        return {"jobs": len(chunk)}
+
+    def install(self, ctx, kind, sub, results):
+        from ..resilience import faults
+
+        for job, ops in zip(sub, results):
+            if ops is None:
+                continue  # band escape: host aligns it
+            faults.check("align.install", (job,))
+            self.pipeline.set_job_cigar(job, ops_to_cigar(ops))
+            self.state["served"] += 1
+            if self.stats is not None:
+                self.stats["device"] = self.stats.get("device", 0) + 1
+            if self.report is not None:
+                self.report.record_served("hirschberg")
+
+    def surrender(self, ctx, items, exported):
+        pass  # CIGAR-less jobs fall to the native host pass
+
+    def quarantine(self, ctx, job, exc):
+        if self.report is not None:
+            self.report.record_quarantine(job, exc)
+
+    def demote(self, ctx, kind, cause):
+        import sys
+
+        self.dead = True
+        print(f"[racon_tpu::align] WARNING: hirschberg engine failed "
+              f"({type(cause).__name__}: {cause}); remaining jobs fall "
+              f"back to the host aligner", file=sys.stderr)
+        if self.report is not None:
+            self.report.record_degrade("hirschberg", "host", cause)
+        return "host"
+
+    def done(self, ctx, chunk):
+        # keep host memory O(cohort): packed views die with the chunk
+        for job in chunk:
+            self.pairs.pop(job, None)
+
+
 def run_jobs(pipeline, jobs, cohort: int = None, report=None,
-             stats=None) -> int:
+             stats=None, lengths=None) -> int:
     """Align pipeline jobs with the Hirschberg engine; install CIGARs.
     Returns how many the device served (band escapes fall to host).
-    Jobs are materialized per cohort so host memory stays O(cohort), not
-    O(total bases).
+    Jobs are packed per cohort (single copy into padded buffers) so host
+    memory stays O(cohort), not O(total bases).
 
-    Each cohort runs through the degradation lattice: bounded retry, then
-    bisection (a poisoned job is quarantined to the host while the rest
-    of the cohort stays on the device).  A cohort-independent failure
-    stops the engine and leaves the remaining jobs CIGAR-less for the
-    host — the served count stays accurate for the cohorts already
-    installed, whatever point the engine died at.  ``stats['device']``
-    (when the driver passes its accounting dict) is incremented per
-    install, so even an exception escaping this function cannot erase
-    already-installed work from the driver's device count."""
+    Cohorts are length-bucketed by (band, first-round row bucket) so a
+    cohort launches geometry-homogeneous kernel batches — one long pair
+    no longer drags a cohort of short pairs through its row splits.
+
+    `lengths` is the bulk job-lengths array (the driver fetches it once
+    and threads it through); without it, one bulk FFI fetch happens here.
+
+    Each cohort runs through the degradation lattice via the shared
+    executor: bounded retry, then bisection (a poisoned job is
+    quarantined to the host while the rest of the cohort stays on the
+    device).  A cohort-independent failure stops the engine and leaves
+    the remaining jobs CIGAR-less for the host — the served count stays
+    accurate for the cohorts already installed, whatever point the
+    engine died at.  ``stats['device']`` (when the driver passes its
+    accounting dict) is incremented per install, so even an exception
+    escaping this function cannot erase already-installed work from the
+    driver's device count."""
     import sys
 
-    from ..resilience import faults
     from ..resilience import lattice as rl
     from .. import obs
+    from .batch_exec import BatchExecutor
 
     if cohort is None:
         cohort = cohort_size()
-    served = 0
-    lengths = (pipeline.align_job_lengths()
-               if obs.enabled() and hasattr(pipeline, "align_job_lengths")
-               else None)
-    for off in range(0, len(jobs), cohort):
-        group = jobs[off:off + cohort]
-        if lengths is not None:
-            # Measured-cell counter for the cost model (obs/costmodel.py):
-            # forward+backward distance passes over the recursion tree
-            # ~ 2x the base max(n,m) x band DP.
-            obs.count("align.cells.hirschberg", sum(
-                2 * max(int(lengths[j, 0]), int(lengths[j, 1]))
-                * band_for(int(lengths[j, 0]), int(lengths[j, 1]))
-                for j in group))
+    if lengths is None and hasattr(pipeline, "align_job_lengths"):
+        lengths = pipeline.align_job_lengths()
+    if lengths is not None:
+        dims = {j: (int(lengths[j, 0]), int(lengths[j, 1])) for j in jobs}
+    else:  # duck-typed pipelines without the lengths table
+        dims = {}
+        for job in jobs:
+            qa, ta = pipeline.align_job(job)
+            dims[job] = (len(qa), len(ta))
 
-        def attempt(sub):
-            faults.check("align.run", sub)
-            pairs = []
-            for job in sub:
-                qa, ta = pipeline.align_job(job)
-                pairs.append((encode(qa).astype(np.int32),
-                              encode(ta).astype(np.int32)))
-            return align_pairs(pairs)
+    # Length buckets: band x the first split round's row bucket — the
+    # geometry key align_pairs' rounds compile under.
+    buckets = {}
+    for job in jobs:
+        n, m = dims[job]
+        K = band_for(n, m)
+        half = (max(n, 1) + 1) // 2
+        rcap = next((rb for rb in ROW_BUCKETS if half <= rb), 0)
+        buckets.setdefault((K, rcap), []).append(job)
 
-        try:
-            with obs.span("align.cohort", tier="hirschberg",
-                          jobs=len(group)):
-                pairs_results, quarantined = rl.serve_with_bisect(
-                    group, attempt, tier="hirschberg", report=report)
-            for sub, results in pairs_results:
-                for job, ops in zip(sub, results):
-                    if ops is None:
-                        continue  # band escape: host aligns it
-                    faults.check("align.install", (job,))
-                    pipeline.set_job_cigar(job, ops_to_cigar(ops))
-                    served += 1
-                    if stats is not None:
-                        stats["device"] = stats.get("device", 0) + 1
-                    if report is not None:
-                        report.record_served("hirschberg")
-            for job, exc in quarantined:
-                if report is not None:
-                    report.record_quarantine(job, exc)
-        except Exception as e:  # noqa: BLE001 — lattice boundary
-            cause = e.cause if isinstance(e, rl.TierDead) else e
-            print(f"[racon_tpu::align] WARNING: hirschberg engine failed "
-                  f"({type(cause).__name__}: {cause}); {len(jobs) - off} "
-                  f"remaining jobs fall back to the host aligner",
-                  file=sys.stderr)
-            if report is not None:
-                report.record_degrade("hirschberg", "host", cause)
-            break
-    return served
+    state = {"served": 0}
+    ops_obj = _HirschbergOps(pipeline, dims, report, stats, state)
+    executor = BatchExecutor(ops_obj, report=report)
+    try:
+        for (K, rcap), items in sorted(buckets.items()):
+            for off in range(0, len(items), cohort):
+                group = items[off:off + cohort]
+                if obs.enabled():
+                    # Measured-cell counter for the cost model
+                    # (obs/costmodel.py): forward+backward distance
+                    # passes over the recursion tree ~ 2x the base
+                    # max(n,m) x band DP.
+                    obs.count("align.cells.hirschberg", sum(
+                        2 * max(dims[j][0], dims[j][1])
+                        * band_for(dims[j][0], dims[j][1])
+                        for j in group))
+                executor.submit(None, group)
+        executor.flush()
+    except Exception as e:  # noqa: BLE001 — lattice boundary
+        cause = e.cause if isinstance(e, rl.TierDead) else e
+        print(f"[racon_tpu::align] WARNING: hirschberg engine failed "
+              f"({type(cause).__name__}: {cause}); remaining jobs fall "
+              f"back to the host aligner", file=sys.stderr)
+        if report is not None:
+            report.record_degrade("hirschberg", "host", cause)
+    if report is not None:
+        executor.stamp_walls(report)
+    return state["served"]

@@ -22,7 +22,6 @@ from __future__ import annotations
 import functools
 import sys
 import time
-from collections import deque
 from typing import List
 
 import numpy as np
@@ -33,6 +32,7 @@ from ..resilience import lattice as rl
 from ..resilience.journal import replay_windows
 from ..resilience.report import PhaseReport
 from . import poa
+from .batch_exec import BatchExecutor, pipeline_depth as _pipeline_depth
 from .encoding import decode, encode
 
 DEPTH_CAP = 200                    # reference: MAX_DEPTH_PER_WINDOW
@@ -62,11 +62,6 @@ AUDIT_WINDOW_LENGTHS = (500, 1000)
 #: the jaxpr audit (racon_tpu/analysis) fails tier-1 — silent recompile
 #: blow-ups are the single biggest TPU serving-latency cliff.
 POA_RECOMPILE_BUDGET = 6
-
-
-def _pipeline_depth() -> int:
-    """How many packed chunks may be in flight on the device at once."""
-    return max(1, config.get_int("RACON_TPU_PIPELINE_DEPTH"))
 
 
 def _batch_size() -> int:
@@ -244,19 +239,20 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
             buckets.setdefault((bucket, window_class(bb)),
                                []).append((i, depth, bb))
 
-        # In-flight chunks: (chunk, packed, outs, cfg, kind).
-        # JAX dispatch is async, so with depth Q the host packs/exports
-        # chunks N+1..N+Q while chunk N executes — the analogue of the
-        # reference's continuous batch fill running concurrently with
-        # kernel execution (cudapolisher.cpp:83-145). Depth >= 2 keeps the
-        # device busy across the host's pack gap even when pack time
-        # fluctuates; more mostly adds host memory (Q packed batches).
-        pending = deque()
-        q_depth = _pipeline_depth()
         # geometries (cfg, kind) whose kernel already failed — seeded from
         # warm-up failures so the measured run never retries a kernel the
         # warm-up proved dead
         dead_geoms = set(_WARM_DEAD)
+        # The shared executor (ops/batch_exec.py) owns the in-flight
+        # queue: JAX dispatch is async, so with depth Q the host
+        # packs/exports chunks N+1..N+Q while chunk N executes — the
+        # analogue of the reference's continuous batch fill running
+        # concurrently with kernel execution (cudapolisher.cpp:83-145).
+        # This driver is only the bucket policy on top of it.
+        executor = BatchExecutor(
+            _ConsensusOps(pipeline, B, trim, stats, fallback, report,
+                          journal, dead_geoms),
+            report=report)
         for (depth_bucket, wl_class), bucket_jobs in sorted(buckets.items()):
             obs.count(f"poa.windows.d{depth_bucket}.c{wl_class}",
                       len(bucket_jobs))
@@ -292,50 +288,18 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
                 # union over its 8 windows, so mixing a short window into
                 # a long group bills it the long group's ranks.
                 bucket_jobs.sort(key=lambda job: (job[1], job[2]))
+                ctx = _BucketCtx(cfg, entry_kind)
                 for off in range(0, len(bucket_jobs), B):
-                    idxs = [i for i, _, _ in bucket_jobs[off:off + B]]
-                    # best LIVE tier for this geometry (earlier chunks or
-                    # the warm-up may have proven tiers dead)
-                    kernel, kind = _live_tier(cfg, B, entry_kind,
-                                              dead_geoms, report)
-                    if kind == "host":
-                        fallback.extend(idxs)
-                        continue
-                    chunk = _export_chunk(pipeline, idxs, cfg, fallback,
-                                          stats, report)
-                    if not chunk:
-                        continue
-                    # Always pad to B: a dataset-size-dependent
-                    # final-chunk shape would force an extra jit compile
-                    # per distinct remainder (padded windows are
-                    # 1-base/0-layer — free).
-                    packed = _pack(chunk, cfg, B)
-                    try:
-                        faults.check(f"poa.run.{kind}",
-                                     [i for i, _, _ in chunk])
-                        outs = _submit(kernel, packed,
-                                       kind in _PALLAS_KINDS)
-                    except Exception as e:  # noqa: BLE001 — lattice edge
-                        # synchronous dispatch failure: resolve this
-                        # chunk through the lattice right now
-                        # (retry/bisect/demote)
-                        report.record_failure(kind, e)
-                        report.retries += 1
-                        _resolve(pipeline, chunk, None, cfg, B, kind,
-                                 dead_geoms, trim, stats, fallback,
-                                 report, journal)
-                        continue
-                    pending.append((chunk, packed, outs, cfg, kind))
-                    if len(pending) >= q_depth:
-                        _drain(pipeline, pending.popleft(), trim, stats,
-                               fallback, B, dead_geoms, report, journal)
+                    executor.submit(
+                        ctx, [i for i, _, _ in bucket_jobs[off:off + B]])
                 if progress:
                     print(f"[racon_tpu::poa] bucket depth<={depth_bucket} "
                           f"len<={wl_class}: {len(bucket_jobs)} windows",
                           file=sys.stderr)
-        while pending:
-            _drain(pipeline, pending.popleft(), trim, stats, fallback, B,
-                   dead_geoms, report, journal)
+        executor.flush()
+        # feeder split (VERDICT #7): host pack wall vs blocked kernel
+        # wall, stamped for bench.py's machine-checkable criterion
+        executor.stamp_walls(report)
 
     t0 = time.perf_counter()
     with obs.span("poa.host_fallback", windows=len(fallback)):
@@ -354,6 +318,45 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
     # datasets
     report.extra["layers_dropped_maxlen"] = stats["layers_dropped"]
     return stats
+
+
+def observed_window_lengths(draft_path: str, w: int) -> set:
+    """Every window length the consensus phase will actually derive.
+
+    run_consensus_phase buckets kernel geometry by the OBSERVED backbone
+    classes, not the nominal -w (the metadata pass above). Windows are
+    fixed-size chunks of draft contigs (rt_pipeline.cpp window build), so
+    the set is computable from the draft FASTA alone: per contig, w for
+    the full chunks plus the tail remainder. Warming only the nominal w
+    would leave the tail-class geometries to compile inside the timed
+    pass.  Shared by bench.py's prewarm and the pipelined polisher's
+    warm-up thread (polisher.py)."""
+    import gzip
+
+    lens = set()
+
+    def add(contig_len):
+        if contig_len <= 0:
+            return
+        if contig_len >= w:
+            lens.add(w)
+        rem = contig_len % w
+        if contig_len < w:
+            lens.add(contig_len)
+        elif rem:
+            lens.add(rem)
+
+    opener = gzip.open if draft_path.endswith(".gz") else open
+    cur = 0
+    with opener(draft_path, "rt") as f:
+        for line in f:
+            if line.startswith(">"):
+                add(cur)
+                cur = 0
+            else:
+                cur += len(line.strip())
+    add(cur)
+    return lens or {1}
 
 
 # (cfg, kind) pairs whose kernel failed during warm-up; consulted by
@@ -460,68 +463,96 @@ def _warn_degrade(e, to_kind: str) -> None:
           file=sys.stderr)
 
 
-def _resolve(pipeline, chunk, outs, cfg, B, kind, dead_geoms, trim, stats,
-             fallback, report, journal=None):
-    """Fully serve one exported chunk through the lattice, starting at
-    `kind` with optionally already-dispatched device futures `outs`.
+class _BucketCtx:
+    """Per-(depth, class) bucket context the executor threads through the
+    ops hooks: the geometry, its entry tier, and the kernel handle the
+    most recent live_tier resolution built."""
 
-    Per tier: bounded retry, then batch bisection (a poisoned window is
+    __slots__ = ("cfg", "entry_kind", "kernel")
+
+    def __init__(self, cfg, entry_kind):
+        self.cfg = cfg
+        self.entry_kind = entry_kind
+        self.kernel = None
+
+
+class _ConsensusOps:
+    """poa_driver's hooks for the shared executor (ops/batch_exec.py):
+    bucket policy, pack/submit/unpack, and the journal/sanitizer/report
+    seams.  Failure semantics are exactly the pre-extraction driver's:
+    per tier bounded retry, then batch bisection (a poisoned window is
     quarantined to the host while the rest of the batch stays on the
-    device); a batch-independent failure (TierDead) demotes the geometry
-    one tier, down to the host floor.
-    """
-    submitted_kind = kind
-    while True:
-        kernel, kind = _live_tier(cfg, B, kind, dead_geoms, report)
-        if kind == "host":
-            for i, _, _ in chunk:
-                fallback.append(i)
-            return
+    device); a batch-independent failure demotes the geometry one tier,
+    down to the host floor."""
+
+    span_name = "poa.chunk"
+    async_dispatch = True
+
+    def __init__(self, pipeline, B, trim, stats, fallback, report,
+                 journal, dead_geoms):
+        self.pipeline = pipeline
+        self.B = B
+        self.trim = trim
+        self.stats = stats
+        self.fallback = fallback
+        self.report = report
+        self.journal = journal
+        self.dead_geoms = dead_geoms
+
+    def live_tier(self, ctx, kind):
+        # best LIVE tier for this geometry (earlier chunks or the warm-up
+        # may have proven tiers dead)
+        ctx.kernel, kind = _live_tier(ctx.cfg, self.B,
+                                      kind or ctx.entry_kind,
+                                      self.dead_geoms, self.report)
+        return kind
+
+    def export(self, ctx, idxs):
+        return _export_chunk(self.pipeline, idxs, ctx.cfg, self.fallback,
+                             self.stats, self.report)
+
+    def pack(self, ctx, chunk):
+        # Always pad to B: a dataset-size-dependent final-chunk shape
+        # would force an extra jit compile per distinct remainder (padded
+        # windows are 1-base/0-layer — free).
+        return _pack(chunk, ctx.cfg, self.B)
+
+    def dispatch(self, ctx, kind, packed, chunk):
+        faults.check(f"poa.run.{kind}", [i for i, _, _ in chunk])
+        return _submit(ctx.kernel, packed, kind in _PALLAS_KINDS)
+
+    def attempt(self, ctx, kind, sub):
         pallas = kind in _PALLAS_KINDS
+        faults.check(f"poa.run.{kind}", [i for i, _, _ in sub])
+        return _unpack(_submit(ctx.kernel, _pack(sub, ctx.cfg, self.B),
+                               pallas), pallas)
 
-        def attempt(sub, _kernel=kernel, _kind=kind, _pallas=pallas):
-            faults.check(f"poa.run.{_kind}", [i for i, _, _ in sub])
-            return _unpack(_submit(_kernel, _pack(sub, cfg, B), _pallas),
-                           _pallas)
+    def unpack(self, ctx, kind, outs):
+        return _unpack(outs, kind in _PALLAS_KINDS)
 
-        # the pipelined futures are only valid for the tier they were
-        # dispatched on; a demotion in between invalidates them
-        cached = None
-        if outs is not None and kind == submitted_kind:
-            cached = (lambda _o=outs, _p=pallas: _unpack(_o, _p))
-        try:
-            with obs.span("poa.chunk", tier=kind, windows=len(chunk),
-                          pipelined=cached is not None):
-                pairs, quarantined = rl.serve_with_bisect(
-                    chunk, attempt, tier=kind, report=report,
-                    cached=cached)
-        except rl.TierDead as td:
-            dead_geoms.add((cfg, kind))
-            nxt = _next_tier(cfg, kind)
-            report.record_degrade(kind, nxt, td.cause)
-            _warn_degrade(td.cause, nxt)
-            outs = None
-            kind = nxt
-            continue
-        for sub, results in pairs:
-            _install(pipeline, sub, results, trim, stats, fallback,
-                     report, kind, journal)
-        for item, exc in quarantined:
-            fallback.append(item[0])
-            report.record_quarantine(item[0], exc)
-        return
+    def span_args(self, ctx, chunk, pipelined):
+        return {"windows": len(chunk), "pipelined": pipelined}
 
+    def install(self, ctx, kind, sub, results):
+        _install(self.pipeline, sub, results, self.trim, self.stats,
+                 self.fallback, self.report, kind, self.journal)
 
-def _drain(pipeline, pending, trim, stats, fallback, B, dead_geoms,
-           report, journal=None):
-    """Block on an in-flight chunk's device results and install them.
+    def surrender(self, ctx, items, exported):
+        if exported:
+            self.fallback.extend(i for i, _, _ in items)
+        else:
+            self.fallback.extend(items)
 
-    If the kernel failed at runtime (error surfaces at the blocking
-    transfer), the chunk is resolved through the lattice — retry, bisect,
-    demote — with the packed arrays still on hand."""
-    chunk, packed, outs, cfg, kind = pending
-    _resolve(pipeline, chunk, outs, cfg, B, kind, dead_geoms, trim, stats,
-             fallback, report, journal)
+    def quarantine(self, ctx, item, exc):
+        self.fallback.append(item[0])
+        self.report.record_quarantine(item[0], exc)
+
+    def demote(self, ctx, kind, cause):
+        self.dead_geoms.add((ctx.cfg, kind))
+        nxt = _next_tier(ctx.cfg, kind)
+        self.report.record_degrade(kind, nxt, cause)
+        _warn_degrade(cause, nxt)
+        return nxt
 
 
 def _use_pallas() -> bool:

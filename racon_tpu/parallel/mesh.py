@@ -17,6 +17,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS = "windows"
 
+# jax >= 0.7 promotes shard_map to the public namespace and renames the
+# replication-check kwarg check_rep -> check_vma; 0.4.x only has the
+# experimental spelling.  Resolve once at import so shard_batch_build
+# works on both.
+try:
+    _shard_map = jax.shard_map
+    _NO_CHECK = {"check_vma": False}
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _NO_CHECK = {"check_rep": False}
+
 
 def device_mesh(devices: Optional[Sequence] = None) -> Mesh:
     devs = list(devices if devices is not None else jax.devices())
@@ -47,10 +58,10 @@ def shard_batch_build(build_local, batch, n_in, n_out):
         return None
     local = build_local(batch // n_dev)
     out_specs = (P(AXIS),) * n_out if n_out > 1 else P(AXIS)
-    return jax.jit(jax.shard_map(
+    return jax.jit(_shard_map(
         lambda *a: local(*a), mesh=device_mesh(),
         in_specs=(P(AXIS),) * n_in, out_specs=out_specs,
-        check_vma=False))
+        **_NO_CHECK))
 
 
 def divisible_batch(n_devices: int, b: int) -> int:

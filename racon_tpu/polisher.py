@@ -17,6 +17,7 @@ output.
 
 from __future__ import annotations
 
+import sys
 import time
 from typing import List, Optional, Tuple
 
@@ -26,6 +27,69 @@ from .resilience import faults, watchdog
 from .resilience.journal import (Journal, input_fingerprint,
                                  replay_windows)
 from .resilience.report import PhaseReport, RunReport
+
+#: Handoff-queue sentinel: the alignment worker is done.
+_DONE = object()
+
+
+class _WorkerFailure:
+    """An exception captured on the alignment worker thread, re-raised on
+    the consumer so a pipelined polish fails exactly like a sequential
+    one (instead of hanging on the queue)."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def _split_fasta(target_path: str, n_chunks_hint: int, outdir: str):
+    """Split a multi-contig FASTA into up to `n_chunks_hint` contiguous,
+    roughly base-balanced chunk files (record text copied verbatim, so
+    each chunk parses to byte-identical contigs).  Returns the chunk
+    paths, or None when the target is not splittable (single contig,
+    non-FASTA content) — the caller falls back to sequential phases."""
+    import gzip
+    import os
+
+    opener = gzip.open if target_path.lower().endswith(".gz") else open
+    records = []   # [bases, [raw lines]]
+    cur = None
+    try:
+        with opener(target_path, "rt") as f:
+            for line in f:
+                if line.startswith(">"):
+                    cur = [0, [line]]
+                    records.append(cur)
+                elif cur is None:
+                    return None   # leading non-FASTA content
+                else:
+                    cur[0] += len(line.strip())
+                    cur[1].append(line)
+    except (OSError, UnicodeDecodeError):
+        return None
+    if len(records) < 2:
+        return None
+    k = min(len(records), max(2, n_chunks_hint))
+    per_chunk = sum(r[0] for r in records) / k
+    paths = []
+    idx = 0
+    for ci in range(k):
+        must_leave = k - ci - 1   # later chunks each need >= 1 contig
+        group = [records[idx]]
+        acc = records[idx][0]
+        idx += 1
+        while (len(records) - idx > must_leave
+               and (ci == k - 1 or acc + records[idx][0] <= per_chunk)):
+            group.append(records[idx])
+            acc += records[idx][0]
+            idx += 1
+        path = os.path.join(outdir, f"chunk{ci:03d}.fasta")
+        with open(path, "w") as f:
+            for _, lines in group:
+                f.writelines(lines)
+        paths.append(path)
+    return paths
 
 
 def _open_journal(paths: Tuple[str, str, str], backend: str,
@@ -142,11 +206,29 @@ class TpuPolisher:
         obs.reset()        # per-run trace/metrics (disarmed unless armed
         obs.configure(trace_path=trace_path)  # by --trace / the knobs)
         self._kwargs = dict(kwargs)
+        self._paths = (sequences_path, overlaps_path, target_path)
         self._journal = _open_journal(
-            (sequences_path, overlaps_path, target_path), "tpu",
-            journal_path, resume_journal, kwargs)
-        self._pipeline = Pipeline(sequences_path, overlaps_path, target_path,
-                                  **kwargs)
+            self._paths, "tpu", journal_path, resume_journal, kwargs)
+        # Cross-phase pipelining (RACON_TPU_PIPELINE_PHASES=1): POA for
+        # early target chunks runs while late alignment cohorts are still
+        # in flight on a worker thread.  The journal records windows by
+        # run-global index; a chunked run would journal chunk-local
+        # indices, so journaled runs stay sequential.
+        self._pipelined = config.get_bool("RACON_TPU_PIPELINE_PHASES")
+        if self._pipelined and self._journal is not None:
+            print("[racon_tpu::polisher] NOTE: RACON_TPU_PIPELINE_PHASES "
+                  "ignored — the window journal needs run-global indices; "
+                  "running the phases sequentially", file=sys.stderr)
+            self._pipelined = False
+        # Pipelined mode parses per target chunk; the full-target
+        # Pipeline is only built when we end up sequential.
+        self._pipeline = (None if self._pipelined else
+                          Pipeline(sequences_path, overlaps_path,
+                                   target_path, **kwargs))
+        self._queue = None
+        self._worker = None
+        self._warm = None
+        self._tmpdir = None
         self.report = RunReport()
 
     def initialize(self) -> None:
@@ -158,6 +240,14 @@ class TpuPolisher:
                 "run without --tpu for the host path") from e
 
         obs.maybe_start_device_trace()
+        if self._pipelined:
+            chunks = self._split_target()
+            if chunks is not None:
+                self._start_phase_pipeline(chunks, run_alignment_phase)
+                return
+            self._pipelined = False
+        if self._pipeline is None:
+            self._pipeline = Pipeline(*self._paths, **self._kwargs)
         with obs.span("phase.parse"):
             self._pipeline.prepare()
         with obs.span("phase.align") as sp:
@@ -168,20 +258,154 @@ class TpuPolisher:
         with obs.span("phase.window_assign"):
             self._pipeline.build_windows()
 
+    # -- phase pipelining --------------------------------------------------
+    def _split_target(self):
+        """Chunk the target FASTA for the phase pipeline; None (with a
+        note) when the input is not splittable — sequential fallback."""
+        import tempfile
+
+        target = self._paths[2]
+        if not target.lower().endswith((".fa", ".fasta",
+                                        ".fa.gz", ".fasta.gz")):
+            print("[racon_tpu::polisher] NOTE: phase pipelining needs a "
+                  "FASTA target; running the phases sequentially",
+                  file=sys.stderr)
+            return None
+        depth = max(1, config.get_int("RACON_TPU_HANDOFF_DEPTH"))
+        self._tmpdir = tempfile.mkdtemp(prefix="racon_tpu_chunks.")
+        chunks = _split_fasta(target, depth + 2, self._tmpdir)
+        if chunks is None:
+            import shutil
+
+            shutil.rmtree(self._tmpdir, ignore_errors=True)
+            self._tmpdir = None
+            print("[racon_tpu::polisher] NOTE: target has fewer than two "
+                  "contigs; running the phases sequentially",
+                  file=sys.stderr)
+        return chunks
+
+    def _start_phase_pipeline(self, chunks, run_alignment_phase) -> None:
+        """Arm the bounded handoff queue, the kernel prewarm thread (its
+        compiles overlap the alignment phase instead of serializing
+        before POA), and the single alignment worker.  One worker + FIFO
+        queue = chunks arrive at POA in target order, so the stitched
+        output is byte-identical to a sequential run."""
+        import queue
+        import threading
+
+        from .ops import poa_driver
+
+        kwargs = self._kwargs
+        seqs, ovls, target = self._paths
+
+        def warm():
+            try:
+                w = int(kwargs.get("window_length", 500))
+                lens = poa_driver.observed_window_lengths(target, w)
+                poa_driver.warm_geometries(lens, kwargs.get("match", 3),
+                                           kwargs.get("mismatch", -5),
+                                           kwargs.get("gap", -4))
+            except Exception as e:  # noqa: BLE001 — prewarm is best-effort
+                print(f"[racon_tpu::polisher] WARNING: consensus prewarm "
+                      f"failed ({type(e).__name__}: {e}); kernels compile "
+                      f"on first use", file=sys.stderr)
+
+        self._warm = threading.Thread(target=warm, name="poa-warm",
+                                      daemon=True)
+        self._warm.start()
+
+        depth = max(1, config.get_int("RACON_TPU_HANDOFF_DEPTH"))
+        self._queue = q = queue.Queue(maxsize=depth)
+
+        def worker():
+            try:
+                for ci, chunk_path in enumerate(chunks):
+                    with obs.span("phase.parse", chunk=ci):
+                        pl = Pipeline(seqs, ovls, chunk_path, **kwargs)
+                        pl.prepare()
+                    with obs.span("phase.align", chunk=ci) as sp:
+                        stats = run_alignment_phase(pl, journal=None)
+                        sp.set(device=stats.get("device"),
+                               host=stats.get("host"))
+                    with obs.span("phase.window_assign", chunk=ci):
+                        pl.build_windows()
+                    q.put((ci, pl, stats))
+                q.put(_DONE)
+            except BaseException as e:  # noqa: BLE001 — re-raised on main
+                q.put(_WorkerFailure(e))
+
+        self._worker = threading.Thread(target=worker, name="align-worker",
+                                        daemon=True)
+        self._worker.start()
+
+    def _polish_pipelined(self, drop_unpolished: bool):
+        from .ops.poa_driver import run_consensus_phase
+
+        align_rep = None
+        cons_rep = None
+        out: List[Tuple[str, str]] = []
+        try:
+            # The prewarm compiles overlapped the alignment phase; POA
+            # must not start until the geometries (and _WARM_DEAD) are
+            # settled.
+            if self._warm is not None:
+                self._warm.join()
+            while True:
+                item = self._queue.get()
+                if item is _DONE:
+                    break
+                if isinstance(item, _WorkerFailure):
+                    raise item.exc
+                ci, pl, stats = item
+                rep = stats.get("report")
+                if rep is not None:
+                    if align_rep is None:
+                        align_rep = rep
+                    else:
+                        align_rep.merge(rep)
+                with obs.span("phase.poa", chunk=ci):
+                    cstats = run_consensus_phase(
+                        pl,
+                        match=self._kwargs.get("match", 3),
+                        mismatch=self._kwargs.get("mismatch", -5),
+                        gap=self._kwargs.get("gap", -4),
+                        trim=self._kwargs.get("trim", True),
+                        journal=None)
+                crep = cstats.get("report")
+                if crep is not None:
+                    if cons_rep is None:
+                        cons_rep = crep
+                    else:
+                        cons_rep.merge(crep)
+                with obs.span("phase.stitch", chunk=ci):
+                    out.extend(pl.stitch(drop_unpolished))
+        finally:
+            if self._tmpdir is not None:
+                import shutil
+
+                shutil.rmtree(self._tmpdir, ignore_errors=True)
+                self._tmpdir = None
+        self.report.attach(align_rep)
+        self.report.attach(cons_rep)
+        return out
+
     def polish(self, drop_unpolished: bool = True) -> List[Tuple[str, str]]:
         from .ops.poa_driver import run_consensus_phase
 
-        with obs.span("phase.poa"):
-            stats = run_consensus_phase(
-                self._pipeline,
-                match=self._kwargs.get("match", 3),
-                mismatch=self._kwargs.get("mismatch", -5),
-                gap=self._kwargs.get("gap", -4),
-                trim=self._kwargs.get("trim", True),
-                journal=self._journal)
-        self.report.attach(stats.get("report"))
-        with obs.span("phase.stitch"):
-            out = self._pipeline.stitch(drop_unpolished)
+        if self._pipelined:
+            out = self._polish_pipelined(drop_unpolished)
+        else:
+            with obs.span("phase.poa"):
+                stats = run_consensus_phase(
+                    self._pipeline,
+                    match=self._kwargs.get("match", 3),
+                    mismatch=self._kwargs.get("mismatch", -5),
+                    gap=self._kwargs.get("gap", -4),
+                    trim=self._kwargs.get("trim", True),
+                    journal=self._journal)
+            self.report.attach(stats.get("report"))
+            with obs.span("phase.stitch"):
+                out = self._pipeline.stitch(drop_unpolished)
         if self._journal is not None:
             self._journal.close()
         self.report.finalize().write_env()

@@ -82,6 +82,34 @@ class PhaseReport:
         self.wall_s[tier] = self.wall_s.get(tier, 0.0) + seconds
         obs.observe(f"wall_s.{self.phase}.{tier}", seconds)
 
+    def merge(self, other: "PhaseReport") -> None:
+        """Fold another report for the same phase into this one (the
+        pipelined polisher runs one report per target chunk and merges).
+
+        Pure accounting — the obs counters were already fed at record
+        time on `other`, so merging does NOT re-feed them; the served-sum
+        cross-check stays valid against the merged counts."""
+        self.total += other.total
+        for t, c in other.served.items():
+            self.served[t] = self.served.get(t, 0) + c
+        self.retries += other.retries
+        self.bisections += other.bisections
+        room = _MAX_QUARANTINED - len(self.quarantined)
+        if room > 0:
+            self.quarantined.extend(other.quarantined[:room])
+        self.degradations.extend(other.degradations)
+        for t, msgs in other.causes.items():
+            lst = self.causes.setdefault(t, [])
+            lst.extend(msgs[:max(0, _MAX_CAUSES - len(lst))])
+        for t, s in other.wall_s.items():
+            self.wall_s[t] = self.wall_s.get(t, 0.0) + s
+        for k, v in other.extra.items():
+            cur = self.extra.get(k)
+            if isinstance(cur, (int, float)) and isinstance(v, (int, float)):
+                self.extra[k] = round(cur + v, 6)
+            else:
+                self.extra[k] = v
+
     # -- views ------------------------------------------------------------
     def served_total(self) -> int:
         return sum(self.served.values())
@@ -154,7 +182,10 @@ class RunReport:
                     "quarantined": len(r.quarantined),
                     "degradations": len(r.degradations),
                     "wall_s": {t: round(s, 4)
-                               for t, s in r.wall_s.items()}}
+                               for t, s in r.wall_s.items()},
+                    # pack/kernel wall split and other phase extras ride
+                    # along so bench.py can stamp them into log entries
+                    **({"extra": dict(r.extra)} if r.extra else {})}
             for phase, r in self.phases.items()
         }
         stale = config.unknown_env_knobs()

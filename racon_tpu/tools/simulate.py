@@ -113,7 +113,13 @@ def _mutate_reads(genome: np.ndarray, rng, n_reads: int, mean_len: int,
 def generate(outdir: str, mbp: float = 1.0, coverage: int = 30,
              mean_read: int = 8000, sub: float = 0.05, ins: float = 0.03,
              dele: float = 0.03, draft_error: float = 0.01,
-             seed: int = 11) -> dict:
+             seed: int = 11, contigs: int = 1) -> dict:
+    """`contigs` > 1 splits the genome into that many contiguous draft
+    contigs (contig0..contigN-1, per-contig PAF/SAM coordinates, one @SQ
+    line each) — the multi-contig shape the phase-pipelined polisher
+    chunks on.  The default single-contig output is byte-identical to
+    what this generator always produced (name 'contig', same rng
+    stream)."""
     os.makedirs(outdir, exist_ok=True)
     rng = np.random.default_rng(seed)
     g_len = int(mbp * 1e6)
@@ -122,6 +128,10 @@ def generate(outdir: str, mbp: float = 1.0, coverage: int = 30,
     draft = genome.copy()
     derr = rng.random(g_len) < draft_error
     draft[derr] = BASES[rng.integers(0, 4, int(derr.sum()))]
+
+    k = max(1, min(int(contigs), g_len))
+    bounds = np.linspace(0, g_len, k + 1).astype(int)
+    names = ["contig"] if k == 1 else [f"contig{ci}" for ci in range(k)]
 
     paths = {
         "genome": os.path.join(outdir, "genome.fasta"),
@@ -136,34 +146,41 @@ def generate(outdir: str, mbp: float = 1.0, coverage: int = 30,
         f.write(genome.tobytes().decode())
         f.write("\n")
     with open(paths["draft"], "w") as f:
-        f.write(">contig\n")
-        f.write(draft.tobytes().decode())
-        f.write("\n")
+        for ci, name in enumerate(names):
+            f.write(f">{name}\n")
+            f.write(draft[bounds[ci]:bounds[ci + 1]].tobytes().decode())
+            f.write("\n")
 
-    n_reads = max(1, int(g_len * coverage / mean_read))
     qual_char = chr(33 + 15)
     with open(paths["reads"], "w") as rf, \
             open(paths["overlaps"], "w") as of, \
             open(paths["overlaps_sam"], "w") as sf:
         sf.write("@HD\tVN:1.6\tSO:unsorted\n")
-        sf.write(f"@SQ\tSN:contig\tLN:{g_len}\n")
-        for i, (start, end, strand, seg, fwd, cg) in enumerate(
-                _mutate_reads(genome, rng, n_reads, mean_read, sub, ins,
-                              dele)):
-            name = f"read{i}"
-            rf.write(f"@{name}\n{seg.tobytes().decode()}\n+\n"
-                     f"{qual_char * len(seg)}\n")
-            of.write(f"{name}\t{len(seg)}\t0\t{len(seg)}\t"
-                     f"{'-' if strand else '+'}\tcontig\t{g_len}\t{start}\t"
-                     f"{end}\t{min(len(seg), end - start)}\t"
-                     f"{max(len(seg), end - start)}\t60\n")
-            # SAM record with the TRUE alignment (what minimap2 -a would
-            # approximate): SEQ in target orientation, ground-truth CIGAR
-            cigar, cg_start, _cg_end = cg
-            flag = 16 if strand else 0
-            sf.write(f"{name}\t{flag}\tcontig\t{cg_start + 1}\t60\t{cigar}"
-                     f"\t*\t0\t0\t{fwd.tobytes().decode()}\t"
-                     f"{qual_char * len(fwd)}\n")
+        for ci, name in enumerate(names):
+            sf.write(f"@SQ\tSN:{name}\tLN:{bounds[ci + 1] - bounds[ci]}\n")
+        i = 0   # read numbering is global across contigs
+        for ci, tname in enumerate(names):
+            seg_genome = genome[bounds[ci]:bounds[ci + 1]]
+            t_len = len(seg_genome)
+            n_reads = max(1, int(t_len * coverage / mean_read))
+            for start, end, strand, seg, fwd, cg in _mutate_reads(
+                    seg_genome, rng, n_reads, mean_read, sub, ins, dele):
+                name = f"read{i}"
+                i += 1
+                rf.write(f"@{name}\n{seg.tobytes().decode()}\n+\n"
+                         f"{qual_char * len(seg)}\n")
+                of.write(f"{name}\t{len(seg)}\t0\t{len(seg)}\t"
+                         f"{'-' if strand else '+'}\t{tname}\t{t_len}\t"
+                         f"{start}\t{end}\t{min(len(seg), end - start)}\t"
+                         f"{max(len(seg), end - start)}\t60\n")
+                # SAM record with the TRUE alignment (what minimap2 -a
+                # would approximate): SEQ in target orientation,
+                # ground-truth CIGAR
+                cigar, cg_start, _cg_end = cg
+                flag = 16 if strand else 0
+                sf.write(f"{name}\t{flag}\t{tname}\t{cg_start + 1}\t60\t"
+                         f"{cigar}\t*\t0\t0\t{fwd.tobytes().decode()}\t"
+                         f"{qual_char * len(fwd)}\n")
     return paths
 
 
@@ -175,10 +192,13 @@ def main(argv=None) -> int:
     p.add_argument("--coverage", type=int, default=30)
     p.add_argument("--mean-read", type=int, default=8000)
     p.add_argument("--seed", type=int, default=11)
+    p.add_argument("--contigs", type=int, default=1,
+                   help="split the genome into this many draft contigs "
+                        "(default 1; >1 enables phase-pipelined polishing)")
     args = p.parse_args(argv)
     paths = generate(args.out_directory, mbp=args.mbp,
                      coverage=args.coverage, mean_read=args.mean_read,
-                     seed=args.seed)
+                     seed=args.seed, contigs=args.contigs)
     for k, v in paths.items():
         print(f"{k}: {v}", file=sys.stderr)
     return 0

@@ -1,0 +1,366 @@
+"""The shared bucketed-batch executor (racon_tpu/ops/batch_exec.py):
+every degradation-lattice edge driven deterministically through the
+executor itself with a scripted ops object, plus e2e runs proving both
+real drivers inherit identical fault semantics from the one seam —
+oracle byte-identity and the served-sum invariant intact, including a
+kill=1 journal resume.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+
+import numpy as np
+
+from racon_tpu.ops.batch_exec import BatchExecutor, pipeline_depth
+from racon_tpu.resilience.report import PhaseReport
+
+from test_faults import (_assert_report_sums, _oracle, _tpu_run,
+                         _write_dataset)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------- scripted ops
+
+class FakeOps:
+    """Executor hooks over trivial integer work units.  `fail` maps an
+    attempt invocation index to an exception; `dead_tiers` lists tiers
+    whose every dispatch/attempt fails (forcing TierDead -> demote)."""
+
+    span_name = "fake.chunk"
+
+    def __init__(self, async_dispatch=True, tiers=("fast", "slow", "host"),
+                 fail=None, dead_tiers=(), dispatch_fail=None):
+        self.async_dispatch = async_dispatch
+        self.tiers = list(tiers)
+        self.fail = dict(fail or {})
+        self.dead_tiers = set(dead_tiers)
+        self.dispatch_fail = set(dispatch_fail or ())
+        self.attempts = 0
+        self.dispatches = 0
+        self.unpacks = 0
+        self.installed = []        # (tier, item, result)
+        self.surrendered = []      # (item, exported)
+        self.quarantined = []      # (item, exc)
+        self.demoted = []          # (from, to)
+        self.done_chunks = []
+        self.tier = self.tiers[0]
+
+    # -- protocol ---------------------------------------------------------
+    def live_tier(self, ctx, kind):
+        return self.tier
+
+    def export(self, ctx, idxs):
+        return [i for i in idxs if i >= 0]
+
+    def pack(self, ctx, chunk):
+        return list(chunk)
+
+    def dispatch(self, ctx, kind, packed, chunk):
+        self.dispatches += 1
+        if kind in self.dead_tiers:
+            raise RuntimeError(f"tier {kind} is dead")
+        if self.dispatches in self.dispatch_fail:
+            raise RuntimeError(f"dispatch {self.dispatches} failed")
+        return [x * 10 for x in packed]
+
+    def attempt(self, ctx, kind, sub):
+        self.attempts += 1
+        if kind in self.dead_tiers:
+            raise RuntimeError(f"tier {kind} is dead")
+        exc = self.fail.pop(self.attempts, None)
+        if exc is not None:
+            raise exc
+        return [x * 10 for x in sub]
+
+    def unpack(self, ctx, kind, outs):
+        self.unpacks += 1
+        return list(outs)
+
+    def span_args(self, ctx, chunk, pipelined):
+        return {"n": len(chunk), "pipelined": pipelined}
+
+    def install(self, ctx, kind, sub, results):
+        for item, r in zip(sub, results):
+            self.installed.append((kind, item, r))
+
+    def surrender(self, ctx, items, exported):
+        self.surrendered.extend((i, exported) for i in items)
+
+    def quarantine(self, ctx, item, exc):
+        self.quarantined.append((item, exc))
+
+    def demote(self, ctx, kind, cause):
+        nxt = self.tiers[self.tiers.index(kind) + 1]
+        self.demoted.append((kind, nxt))
+        self.tier = nxt
+        return nxt
+
+    def done(self, ctx, chunk):
+        self.done_chunks.append(list(chunk))
+
+
+def _rep(tiers=("fast", "slow", "host")):
+    return PhaseReport("t", tuple(tiers))
+
+
+# ------------------------------------------------------------- unit tests
+
+def test_depth_pipelined_happy_path_uses_cached_dispatch():
+    ops = FakeOps()
+    ex = BatchExecutor(ops, depth=2, report=_rep())
+    ex.submit(None, [1, 2])
+    ex.submit(None, [3, 4])   # depth reached: chunk 1 resolves via cache
+    ex.flush()
+    assert ops.dispatches == 2
+    assert ops.unpacks == 2           # both chunks resolved from futures
+    assert ops.attempts == 0          # the lattice never re-packed
+    assert [(i, r) for _, i, r in ops.installed] == \
+        [(1, 10), (2, 20), (3, 30), (4, 40)]
+    assert ops.done_chunks == [[1, 2], [3, 4]]
+    assert ex.pack_ns > 0 and ex.kernel_ns > 0
+
+
+def test_stamp_walls_accumulates_into_report_extra():
+    ops = FakeOps()
+    rep = _rep()
+    ex = BatchExecutor(ops, depth=1, report=rep)
+    ex.submit(None, [1])
+    ex.flush()
+    ex.stamp_walls(rep)
+    assert rep.extra["pack_wall_s"] > 0
+    assert rep.extra["kernel_wall_s"] > 0
+    first = rep.extra["pack_wall_s"]
+    ex.stamp_walls(rep)               # accumulating, not overwriting
+    assert rep.extra["pack_wall_s"] >= 2 * first
+    assert "pack_wall_s" in rep.as_dict()["extra"]
+
+
+def test_sync_engine_resolves_inline():
+    ops = FakeOps(async_dispatch=False)
+    ex = BatchExecutor(ops, depth=4, report=_rep())
+    ex.submit(None, [1, 2])
+    # resolved before flush: host-orchestrated engines never queue
+    assert [(i, r) for _, i, r in ops.installed] == [(1, 10), (2, 20)]
+    assert ops.dispatches == 0 and ops.unpacks == 0 and ops.attempts == 1
+    ex.flush()
+    assert len(ops.installed) == 2
+
+
+def test_dispatch_failure_resolves_through_lattice():
+    rep = _rep()
+    ops = FakeOps(dispatch_fail={1})
+    ex = BatchExecutor(ops, depth=1, report=rep)
+    ex.submit(None, [1, 2])
+    ex.flush()
+    # dispatch blew up synchronously -> recorded as a failure + retry,
+    # then the lattice attempt served the chunk at the same tier
+    assert [(i, r) for _, i, r in ops.installed] == [(1, 10), (2, 20)]
+    assert rep.retries >= 1
+    assert rep.causes.get("fast")
+    assert ops.attempts >= 1
+
+
+def test_transient_failure_retried_at_tier(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_TIER_RETRIES", "1")
+    rep = _rep()
+    # sync engine so the FIRST lattice attempt is the serving call
+    ops = FakeOps(async_dispatch=False,
+                  fail={1: RuntimeError("transient")})
+    ex = BatchExecutor(ops, report=rep)
+    ex.submit(None, [1, 2, 3])
+    ex.flush()
+    assert [(i, r) for _, i, r in ops.installed] == \
+        [(1, 10), (2, 20), (3, 30)]
+    assert rep.retries == 1 and rep.bisections == 0
+    assert not ops.demoted
+
+
+def test_poisoned_item_bisected_and_quarantined(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_TIER_RETRIES", "0")
+
+    class PoisonOps(FakeOps):
+        def attempt(self, ctx, kind, sub):
+            self.attempts += 1
+            if 3 in sub:
+                raise RuntimeError("poisoned")
+            return [x * 10 for x in sub]
+
+    rep = _rep()
+    ops = PoisonOps(async_dispatch=False)
+    ex = BatchExecutor(ops, report=rep)
+    ex.submit(None, [1, 2, 3, 4])
+    ex.flush()
+    assert sorted(i for _, i, _ in ops.installed) == [1, 2, 4]
+    assert [i for i, _ in ops.quarantined] == [3]
+    assert rep.bisections >= 1
+    assert not ops.demoted
+
+
+def test_engine_death_demotes_down_to_host(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_TIER_RETRIES", "0")
+    rep = _rep()
+    # every dispatch/attempt at both device tiers fails: fast -> slow ->
+    # host, and the chunk surrenders to the host floor (exported=True)
+    ops = FakeOps(dead_tiers={"fast", "slow"})
+    ex = BatchExecutor(ops, depth=1, report=rep)
+    ex.submit(None, [1, 2])
+    ex.flush()
+    assert ops.demoted == [("fast", "slow"), ("slow", "host")]
+    assert ops.surrendered == [(1, True), (2, True)]
+    assert not ops.installed
+    assert ops.done_chunks == [[1, 2]]   # packed state still released
+
+
+def test_host_entry_tier_surrenders_unexported():
+    ops = FakeOps(tiers=("host",))
+    ex = BatchExecutor(ops, report=_rep())
+    ex.submit(None, [7, 8])
+    ex.flush()
+    assert ops.surrendered == [(7, False), (8, False)]
+    assert ops.dispatches == 0 and ops.attempts == 0
+
+
+def test_empty_export_skips_dispatch():
+    ops = FakeOps()
+    ex = BatchExecutor(ops, report=_rep())
+    ex.submit(None, [-1, -2])     # export filters everything out
+    ex.flush()
+    assert ops.dispatches == 0 and not ops.installed
+
+
+def test_pipeline_depth_knob(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_PIPELINE_DEPTH", "5")
+    assert pipeline_depth() == 5
+    monkeypatch.setenv("RACON_TPU_PIPELINE_DEPTH", "0")
+    assert pipeline_depth() == 1          # floor
+
+
+# ------------------------------------------ e2e through the real drivers
+
+def test_consensus_driver_full_lattice_chain(tmp_path, monkeypatch):
+    """Retry + bisect-quarantine in ONE consensus run, all flowing
+    through the shared executor: output byte-identical to the oracle,
+    served counts sum, pack/kernel wall split stamped."""
+    paths = _write_dataset(tmp_path)
+    oracle = _oracle(paths)
+    res, p = _tpu_run(paths, monkeypatch, {
+        # invocation 0 (pipelined dispatch) fails synchronously, so the
+        # executor records the failure and re-resolves through the
+        # lattice; the window=2 poison then forces a bisect-quarantine
+        "RACON_TPU_FAULT": ("poa.run.xla:batch=0:count=1,"
+                            "poa.run.xla:window=2"),
+    })
+    assert res == oracle
+    d = _assert_report_sums(p)
+    cons = d["phases"]["consensus"]
+    assert cons["quarantined"] == [2]
+    assert cons["served"]["host"] == 1 and cons["served"]["xla"] == 5
+    assert cons["retries"] >= 1 and cons["bisections"] >= 1
+    # the executor stamped the feeder's wall split
+    assert cons["extra"]["kernel_wall_s"] > 0
+    assert cons["extra"]["pack_wall_s"] > 0
+
+
+def test_xla_align_driver_through_executor(tmp_path, monkeypatch):
+    """The moves-matrix aligner now runs on the executor: poisoned job
+    quarantined, the rest stay device-served, wall split stamped."""
+    paths = _write_dataset(tmp_path, overlaps="paf", n_reads=2)
+    oracle = _oracle(paths)
+    res, p = _tpu_run(paths, monkeypatch, {
+        "RACON_TPU_DEVICE_ALIGNER": "xla",
+        "RACON_TPU_FAULT": "align.run:window=3",
+    })
+    assert res == oracle
+    d = _assert_report_sums(p)
+    al = d["phases"]["alignment"]
+    assert 3 in al["quarantined"]
+    assert al["served"]["xla"] == 5 and al["served"]["host"] == 1
+    assert al["bisections"] >= 1
+    assert al["extra"]["kernel_wall_s"] > 0
+
+
+def test_xla_align_engine_death_mid_cohort(monkeypatch):
+    """Engine death after the first cohort resolved: already-installed
+    CIGARs are kept and counted device-served (the ADVICE.md regression,
+    now enforced by the executor's demote/surrender seam)."""
+    rng = random.Random(9)
+    pairs = []
+    for _ in range(6):
+        t = bytes(rng.choice(b"ACGT") for _ in range(300))
+        pairs.append((t, t))
+
+    class FakePipe:
+        def __init__(self, pairs):
+            self.pairs = pairs
+            self.cigars = {}
+
+        def align_job(self, i):
+            q, t = self.pairs[i]
+            return (np.frombuffer(q, np.uint8), np.frombuffer(t, np.uint8))
+
+        def set_job_cigar(self, i, c):
+            self.cigars[i] = c
+
+    monkeypatch.setenv("RACON_TPU_PIPELINE_DEPTH", "1")
+    monkeypatch.setenv("RACON_TPU_TIER_RETRIES", "0")
+    monkeypatch.setenv(
+        "RACON_TPU_FAULT",
+        ",".join(f"align.run:batch={i}" for i in range(1, 12)))
+    from racon_tpu.ops import align
+    rep = PhaseReport("alignment", ("xla", "host"))
+    pipe = FakePipe(pairs)
+    served = align.run_jobs(pipe, list(range(6)), batch=2, report=rep)
+    # cohort 0 (jobs 0,1) was dispatched AND resolved before the engine
+    # died on cohort 1's dispatch; cohorts 1,2 fall to the host
+    assert served == 2
+    assert sorted(pipe.cigars) == [0, 1]
+    assert rep.served.get("xla") == 2
+    assert any(d["from"] == "xla" and d["to"] == "host"
+               for d in rep.as_dict()["degradations"])
+
+
+def test_kill_resume_through_executor(tmp_path):
+    """kill=1 mid-consensus (inside the executor's dispatch fault
+    check), then resume from the journal: already-journaled windows are
+    replayed, the rest recomputed, output byte-identical.  Subprocess
+    because the fault hard-kills the process."""
+    paths = _write_dataset(tmp_path)
+
+    def cli(*extra, env=None):
+        cmd = [sys.executable, "-m", "racon_tpu.cli", "--tpu",
+               "-w", "100", "-q", "10", "-e", "0.3",
+               "-m", "5", "-x", "-4", "-g", "-8", *extra, *paths]
+        full_env = dict(os.environ, JAX_PLATFORMS="cpu",
+                        RACON_TPU_PALLAS="0", RACON_TPU_POA_KERNEL="v2",
+                        RACON_TPU_BATCH_WINDOWS="2")
+        full_env.pop("RACON_TPU_FAULT", None)
+        # conftest's 8-virtual-device XLA_FLAGS would round the 2-window
+        # batches up to one 8-window dispatch and the kill would not fire
+        full_env.pop("XLA_FLAGS", None)
+        full_env.update(env or {})
+        return subprocess.run(cmd, cwd=ROOT, env=full_env,
+                              capture_output=True, timeout=540)
+
+    baseline = cli()
+    assert baseline.returncode == 0, baseline.stderr.decode()
+
+    jp = str(tmp_path / "run.journal")
+    # batch=2 windows/chunk, depth 2: chunk 0 installs (2 windows
+    # journaled) when chunk 1 enters the pipe; the third dispatch kills
+    killed = cli("--journal", jp,
+                 env={"RACON_TPU_FAULT": "poa.run.xla:batch=2:kill=1"})
+    assert killed.returncode != 0
+    assert os.path.exists(jp)
+
+    rp = str(tmp_path / "resume_report.json")
+    resumed = cli("--resume-journal", jp, "--report", rp)
+    assert resumed.returncode == 0, resumed.stderr.decode()
+    assert resumed.stdout == baseline.stdout
+    rep = json.loads(open(rp).read())
+    cons = rep["phases"]["consensus"]
+    assert sum(cons["served"].values()) == cons["total"]
+    assert cons["served"].get("journal", 0) >= 1

@@ -21,8 +21,41 @@ import numpy as np
 import pytest
 
 import jax
+# Not eagerly imported by jax/__init__ on 0.4.x — without this the
+# attribute lookup below hits the deprecation __getattr__ and raises.
+import jax.export
 
 from racon_tpu.ops import align_pallas, poa_driver
+
+
+def _mosaic_lowers_int_reductions():
+    """Capability probe: the production kernels reduce over int32 DP
+    state, which older Mosaic pipelines reject wholesale
+    ("Reductions over integers not implemented").  On such a toolchain
+    this gate cannot run at all — skip with the real reason rather than
+    failing every kernel on the same missing backend feature.  Any
+    OTHER probe failure returns True so the tests still run and surface
+    it loudly."""
+    from jax.experimental import pallas as pl
+    import jax.numpy as jnp
+
+    def k(x_ref, o_ref):
+        o_ref[0, 0] = jnp.max(x_ref[...])
+
+    fn = pl.pallas_call(
+        k, out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32))
+    try:
+        jax.export.export(jax.jit(fn), platforms=["tpu"])(
+            np.zeros((8, 128), np.int32))
+        return True
+    except Exception as e:
+        return "Reductions over integers" not in str(e)
+
+
+pytestmark = pytest.mark.skipif(
+    not _mosaic_lowers_int_reductions(),
+    reason="this jax's Mosaic cannot lower integer reductions; "
+           "the TPU-lowering gate needs a newer toolchain")
 
 
 def _export_tpu(fn, args):
