@@ -3,6 +3,7 @@
 of the CUDA ones.
 
 Usage: racon-tpu [options ...] <sequences> <overlaps> <target sequences>
+       racon-tpu serve [options ...]   (resident polishing daemon)
 """
 
 from __future__ import annotations
@@ -19,6 +20,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
         prog="racon-tpu",
         description="TPU-native consensus module for raw de novo genome "
         "assembly of long uncorrected reads",
+        epilog="subcommands: `racon-tpu serve` runs the resident "
+        "polishing daemon (hot kernels, job queue, preemption-safe "
+        "jobs — see `racon-tpu serve --help`).",
     )
     p.add_argument("sequences", help="FASTA/FASTQ file (optionally gzipped) "
                    "containing sequences used for correction")
@@ -82,6 +86,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    # Subcommand seam (the reference binary's split/subsample pattern):
+    # `racon-tpu serve` hands the rest of the argv to the daemon before
+    # the polish-flags parser ever sees it.
+    if argv and argv[0] == "serve":
+        from .serve.__main__ import main as serve_main
+        return serve_main(argv[1:])
     args = build_arg_parser().parse_args(argv)
 
     from .native import NativeError

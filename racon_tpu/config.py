@@ -119,6 +119,23 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
     _k("RACON_TPU_MACHINE_PROFILE", "auto", "str",
        "machine profile for cost-model predictions: auto | cpu-host | "
        "tpu-v4-lite (auto picks by backend platform)"),
+    # -- serving knobs ----------------------------------------------------
+    _k("RACON_TPU_SERVE_PORT", "0", "int",
+       "TCP port for the `racon-tpu serve` daemon (0 = pick a free "
+       "ephemeral port, recorded in <state-dir>/serve.json)"),
+    _k("RACON_TPU_SERVE_QUEUE_DEPTH", "16", "int",
+       "serve admission control: queued (not yet running) jobs beyond "
+       "which new submissions are rejected"),
+    _k("RACON_TPU_SERVE_MAX_JOBS", "64", "int",
+       "serve admission control: total unfinished (queued + running) "
+       "jobs the daemon will track at once"),
+    _k("RACON_TPU_SERVE_WARMUP", "1", "bool",
+       "pre-compile the consensus kernel geometries once at serve "
+       "startup so the first job pays no kernel builds (0 disables)"),
+    _k("RACON_TPU_SERVE_WINDOW_BUDGET", "0", "int",
+       "serve per-job window budget: jobs whose estimated window count "
+       "exceeds it are demoted to the host lane instead of occupying "
+       "the device queue (0 = unlimited)"),
     # -- test / bench knobs ----------------------------------------------
     _k("RACON_TPU_HW_TESTS", None, "bool",
        "assert exact on-hardware pins against a real TPU backend",

@@ -145,6 +145,15 @@ def snapshot() -> Optional[dict]:
     return None if m is None else m.snapshot()
 
 
+def counter_total(prefix: str) -> int:
+    """Sum of every counter whose name starts with ``prefix`` (0 when
+    metrics are disarmed).  The serve session reads
+    ``counter_total("kernel.builds.")`` after each job to prove the
+    hot-kernel invariant: jobs 2..N on a resident process build nothing."""
+    m = _metrics
+    return 0 if m is None else m.prefix_sum(prefix)
+
+
 def served_sum_check(phases) -> dict:
     """Cross-check the ``served.<phase>.<tier>`` counters against each
     ``PhaseReport``'s served totals.  The counters are fed from

@@ -92,6 +92,26 @@ def _split_fasta(target_path: str, n_chunks_hint: int, outdir: str):
     return paths
 
 
+def reset_run_state(trace_path: Optional[str]) -> None:
+    """Per-run reset of the module-global runtime state, shared by both
+    polisher constructors: the deterministic fault schedule, watchdog
+    wedge streaks, sanitizer findings, and obs arming all start fresh.
+
+    This is the seam the serving layer leans on (racon_tpu/serve): a
+    resident process runs many polishes, so every construction must
+    re-arm per-request state — while everything deliberately *not* reset
+    here (the topology-keyed kernel cache, the XLA compile cache) stays
+    hot across jobs.  It also means in-process polishes cannot overlap;
+    the serve scheduler serializes device-lane jobs for exactly this
+    reason."""
+    faults.reset()     # per-run firing schedule (deterministic)
+    watchdog.reset()   # per-run wedge streaks
+    from .analysis import sanitize
+    sanitize.reset()   # per-run sanitizer findings
+    obs.reset()        # per-run trace/metrics (disarmed unless armed
+    obs.configure(trace_path=trace_path)  # by --trace / the knobs)
+
+
 def _open_journal(paths: Tuple[str, str, str], backend: str,
                   journal_path: Optional[str], resume: bool,
                   params: dict) -> Optional[Journal]:
@@ -116,12 +136,7 @@ class CpuPolisher:
                  target_path: str, journal_path: Optional[str] = None,
                  resume_journal: bool = False,
                  trace_path: Optional[str] = None, **kwargs):
-        faults.reset()     # per-run firing schedule (deterministic)
-        watchdog.reset()   # per-run wedge streaks
-        from .analysis import sanitize
-        sanitize.reset()   # per-run sanitizer findings
-        obs.reset()        # per-run trace/metrics (disarmed unless armed
-        obs.configure(trace_path=trace_path)  # by --trace / the knobs)
+        reset_run_state(trace_path)
         self._journal = _open_journal(
             (sequences_path, overlaps_path, target_path), "cpu",
             journal_path, resume_journal, kwargs)
@@ -199,12 +214,7 @@ class TpuPolisher:
                  target_path: str, journal_path: Optional[str] = None,
                  resume_journal: bool = False,
                  trace_path: Optional[str] = None, **kwargs):
-        faults.reset()     # per-run firing schedule (deterministic)
-        watchdog.reset()   # per-run wedge streaks
-        from .analysis import sanitize
-        sanitize.reset()   # per-run sanitizer findings
-        obs.reset()        # per-run trace/metrics (disarmed unless armed
-        obs.configure(trace_path=trace_path)  # by --trace / the knobs)
+        reset_run_state(trace_path)
         self._kwargs = dict(kwargs)
         self._paths = (sequences_path, overlaps_path, target_path)
         self._journal = _open_journal(

@@ -1,0 +1,46 @@
+"""Polishing-as-a-service: the resident `racon-tpu serve` daemon.
+
+Every CLI invocation pays parse + kernel build + bucket-grid warmup from
+scratch; this package keeps the expensive state resident and streams
+jobs through it (ROADMAP open item #1 — the SeGraM/gpuPairHMM serving
+pattern applied to the polish pipeline):
+
+* ``session``   — PolishSession: one process, many polishes.  Kernels
+  stay hot in the topology-keyed ``ops/kernel_cache`` across jobs; the
+  consensus geometries are pre-compiled once at startup
+  (``poa_driver.warm_geometries``); per-request state (journal, report,
+  trace, fault schedule) is isolated per job directory.
+* ``scheduler`` — queue-based job scheduler multiplexing N concurrent
+  jobs onto one device set: admission control (bounded queue depth +
+  per-job window budget), per-submitter round-robin fairness, and the
+  degradation lattice extended one level up — a job that overruns its
+  budget or fails on the device lane is demoted to a host-lane CLI
+  subprocess (byte-identical output) instead of stalling the queue.
+* ``server`` / ``client`` — localhost TCP daemon speaking a newline-JSON
+  protocol (submit/status/result/cancel/stats/shutdown) and the thin
+  client.  Each request carries its own crash-safe journal, so a
+  preempted job resumes on daemon restart instead of recomputing.
+* ``loadtest``  — concurrent synthetic-job harness reporting throughput
+  and p50/p95/p99 latency plus the cold-first-job vs warm-job delta
+  (see docs/benchmarks.md and ``bench.py serve``).
+
+Entry points: ``python -m racon_tpu.serve`` or
+``python -m racon_tpu.cli serve`` (daemon), ``python -m
+racon_tpu.serve.loadtest`` (harness).
+"""
+
+from .client import ServeClient, ServeError
+from .scheduler import AdmissionError, Scheduler
+from .server import ServeDaemon
+from .session import JobCancelled, JobSpec, PolishSession
+
+__all__ = [
+    "AdmissionError",
+    "JobCancelled",
+    "JobSpec",
+    "PolishSession",
+    "Scheduler",
+    "ServeClient",
+    "ServeDaemon",
+    "ServeError",
+]

@@ -1,0 +1,100 @@
+"""`python -m racon_tpu.serve` / `python -m racon_tpu.cli serve` —
+run the resident polishing daemon."""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+from .server import ServeDaemon
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="racon-tpu serve",
+        description="Resident polishing daemon: kernels stay hot across "
+        "jobs, a queue-based scheduler multiplexes concurrent submissions "
+        "onto one device set, every job journals for preemption-safe "
+        "resume (protocol: newline-JSON over localhost TCP; see "
+        "docs/architecture.md, 'Serving').")
+    p.add_argument("--state-dir", default="./racon-serve",
+                   help="daemon state directory: serve.json (bound port) "
+                   "plus one subdirectory per job holding its spec, "
+                   "journal, trace, report, and polished output "
+                   "(default ./racon-serve)")
+    p.add_argument("--port", type=int, default=None,
+                   help="TCP port to bind on 127.0.0.1 (default: "
+                   "RACON_TPU_SERVE_PORT, 0 = ephemeral)")
+    p.add_argument("--backend", choices=("tpu", "cpu"), default="tpu",
+                   help="session backend for the device lane "
+                   "(default tpu)")
+    p.add_argument("--queue-depth", type=int, default=None,
+                   help="queued-job admission cap (default: "
+                   "RACON_TPU_SERVE_QUEUE_DEPTH)")
+    p.add_argument("--max-jobs", type=int, default=None,
+                   help="unfinished-job admission cap (default: "
+                   "RACON_TPU_SERVE_MAX_JOBS)")
+    p.add_argument("--window-budget", type=int, default=None,
+                   help="per-job window budget; bigger jobs run on the "
+                   "host lane (default: RACON_TPU_SERVE_WINDOW_BUDGET, "
+                   "0 = unlimited)")
+    p.add_argument("--no-warm", action="store_true",
+                   help="skip the startup kernel warm-up (first job then "
+                   "pays the compiles; RACON_TPU_SERVE_WARMUP=0 is the "
+                   "env equivalent)")
+    p.add_argument("--warm-window", type=int, action="append", default=None,
+                   metavar="W",
+                   help="window length(s) to pre-compile geometries for "
+                   "(repeatable; default 500 — pass the -w your jobs use)")
+    p.add_argument("--no-host-lane", action="store_true",
+                   help="disable the host demotion lane (device failures "
+                   "then fail the job instead of retrying on the host)")
+    p.add_argument("-m", "--match", type=int, default=3,
+                   help="match score to warm kernels for (default 3)")
+    p.add_argument("-x", "--mismatch", type=int, default=-5,
+                   help="mismatch score to warm kernels for (default -5)")
+    p.add_argument("-g", "--gap", type=int, default=-4,
+                   help="gap penalty to warm kernels for (default -4)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+
+    from ..resilience import faults
+    try:
+        faults.validate_env()
+    except ValueError as e:
+        print(e, file=sys.stderr)
+        return 1
+    if args.backend == "tpu":
+        from ..ops.poa_driver import _kernel_kind
+        try:
+            _kernel_kind()
+        except ValueError as e:
+            print(e, file=sys.stderr)
+            return 1
+
+    daemon = ServeDaemon(
+        args.state_dir, backend=args.backend, port=args.port,
+        queue_depth=args.queue_depth, max_jobs=args.max_jobs,
+        window_budget=args.window_budget,
+        warm=False if args.no_warm else None,
+        warm_window_lengths=tuple(args.warm_window or (500,)),
+        warm_scores=(args.match, args.mismatch, args.gap),
+        host_lane=not args.no_host_lane)
+
+    def _stop(signum, frame):
+        print(f"[racon_tpu::serve] signal {signum}: shutting down "
+              f"(queued jobs stay recoverable)", file=sys.stderr)
+        daemon.stop(wait=False)
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    daemon.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,296 @@
+"""Load-test harness for the `racon-tpu serve` daemon.
+
+Closed-loop load generation: N client threads, each with its own socket,
+each looping submit -> wait over its share of synthetic polish jobs
+(``tools/simulate.py`` data).  Reports end-to-end latency percentiles
+(p50/p95/p99 — queueing included, that is the point), aggregate
+throughput over the makespan, per-job service walls, and the
+cold-first-job vs warm-job delta that quantifies what the resident
+session amortizes (kernel builds happen once, or zero times when the
+startup warm-up ran).
+
+``--docs PATH`` rewrites the marked block in docs/benchmarks.md with the
+measured numbers; ``bench.py serve`` runs the same harness and stamps a
+normalized entry into the bench history so the `obs bench` regression
+gate covers the daemon path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from .client import ServeClient, ServeError
+
+DOCS_BEGIN = "<!-- serve-loadtest:begin -->"
+DOCS_END = "<!-- serve-loadtest:end -->"
+
+
+def percentile(values: List[float], p: float) -> float:
+    """Nearest-rank percentile on a non-empty list."""
+    import math
+
+    vs = sorted(values)
+    k = max(0, min(len(vs) - 1, math.ceil(p / 100.0 * len(vs)) - 1))
+    return vs[k]
+
+
+def spawn_daemon(state_dir: str, backend: str = "tpu",
+                 window_length: int = 500,
+                 extra_args: Optional[List[str]] = None,
+                 env: Optional[dict] = None,
+                 timeout: float = 300.0) -> subprocess.Popen:
+    """Start a daemon subprocess on an ephemeral port and wait until it
+    answers ping (startup includes the kernel warm-up, so the deadline
+    is generous).  stderr goes to <state_dir>/daemon.stderr.log."""
+    os.makedirs(state_dir, exist_ok=True)
+    cmd = [sys.executable, "-m", "racon_tpu.cli", "serve",
+           "--state-dir", state_dir, "--port", "0", "--backend", backend,
+           "--warm-window", str(window_length)] + (extra_args or [])
+    err_f = open(os.path.join(state_dir, "daemon.stderr.log"), "w")
+    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL, stderr=err_f,
+                            env=env)
+    deadline = time.monotonic() + timeout
+    while True:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"serve daemon exited {proc.returncode} during startup "
+                f"(see {state_dir}/daemon.stderr.log)")
+        try:
+            with ServeClient.from_state_dir(state_dir, timeout=5.0) as c:
+                c.ping()
+            return proc
+        except (OSError, ValueError, ServeError):
+            if time.monotonic() > deadline:
+                proc.kill()
+                raise RuntimeError(
+                    f"serve daemon not reachable after {timeout}s") from None
+            time.sleep(0.2)
+
+
+def run_loadtest(port: int, paths: dict, jobs: int, clients: int,
+                 polish_args: Optional[dict] = None,
+                 backend: str = "", timeout: float = 1200.0) -> dict:
+    """Drive an already-running daemon with `jobs` identical synthetic
+    jobs from `clients` concurrent client threads; returns the summary
+    dict (see module docstring for the metrics)."""
+    polish_args = polish_args or {}
+    clients = max(1, min(clients, jobs))
+    per_job: List[Optional[dict]] = [None] * jobs
+    errors: List[str] = []
+    barrier = threading.Barrier(clients)
+
+    def client_loop(ci: int) -> None:
+        try:
+            with ServeClient(port, timeout=timeout) as c:
+                barrier.wait()
+                for ji in range(ci, jobs, clients):
+                    t0 = time.monotonic()
+                    job_id = c.submit(paths["reads"], paths["overlaps"],
+                                      paths["draft"], args=polish_args,
+                                      backend=backend,
+                                      submitter=f"loadtest-c{ci}")
+                    resp = c.wait(job_id, timeout=timeout)
+                    res = resp.get("result") or {}
+                    per_job[ji] = {
+                        "job_id": job_id,
+                        "latency_s": round(time.monotonic() - t0, 4),
+                        "service_s": res.get("wall_s"),
+                        "cold": bool(res.get("cold")),
+                        "kernel_builds": res.get("kernel_builds"),
+                        "polished_bp": res.get("polished_bp", 0),
+                        "backend": res.get("backend"),
+                        "client": ci,
+                    }
+        except (ServeError, OSError, threading.BrokenBarrierError) as e:
+            errors.append(f"client {ci}: {type(e).__name__}: {e}")
+
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=client_loop, args=(ci,),
+                                name=f"loadtest-c{ci}", daemon=True)
+               for ci in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    makespan = time.monotonic() - t_start
+
+    completed = [r for r in per_job if r is not None]
+    if not completed:
+        raise RuntimeError("loadtest completed no jobs: "
+                           + ("; ".join(errors) or "unknown"))
+    lat = [r["latency_s"] for r in completed]
+    cold = [r for r in completed if r["cold"]]
+    warm = [r for r in completed
+            if not r["cold"] and r["service_s"] is not None]
+    warm_wall = sum(r["service_s"] for r in warm)
+    warm_bp = sum(r["polished_bp"] for r in warm)
+    cold_wall = cold[0]["service_s"] if cold else None
+    warm_mean = round(warm_wall / len(warm), 4) if warm else None
+    summary = {
+        "jobs": jobs,
+        "clients": clients,
+        "completed": len(completed),
+        "errors": errors,
+        "makespan_s": round(makespan, 4),
+        "polished_bp": sum(r["polished_bp"] for r in completed),
+        "throughput_mbps": round(
+            sum(r["polished_bp"] for r in completed) / 1e6 / makespan, 6),
+        "latency_s": {
+            "p50": percentile(lat, 50),
+            "p95": percentile(lat, 95),
+            "p99": percentile(lat, 99),
+            "mean": round(sum(lat) / len(lat), 4),
+            "max": max(lat),
+        },
+        "service_s": {
+            "cold_first_job": cold_wall,
+            "warm_mean": warm_mean,
+            "cold_warm_delta": (round(cold_wall - warm_mean, 4)
+                                if cold_wall is not None
+                                and warm_mean is not None else None),
+        },
+        "warm_mbps": (round(warm_bp / 1e6 / warm_wall, 6)
+                      if warm_wall else None),
+        "warm_kernel_builds": sum(r["kernel_builds"] or 0 for r in warm),
+        "per_job": completed,
+    }
+    return summary
+
+
+# -- docs -------------------------------------------------------------------
+
+def render_markdown(summary: dict, workload: str) -> str:
+    lat = summary["latency_s"]
+    svc = summary["service_s"]
+    lines = [
+        DOCS_BEGIN,
+        f"Measured by `python -m racon_tpu.serve.loadtest` — {workload}; "
+        f"{summary['jobs']} jobs from {summary['clients']} concurrent "
+        f"clients against one daemon:",
+        "",
+        "| metric | value |",
+        "|---|---|",
+        f"| throughput (makespan) | "
+        f"{summary['throughput_mbps']:.4f} Mbp/s |",
+        f"| warm-path throughput | "
+        + (f"{summary['warm_mbps']:.4f} Mbp/s |"
+           if summary["warm_mbps"] is not None else "n/a |"),
+        f"| latency p50 / p95 / p99 | {lat['p50']:.2f} / {lat['p95']:.2f} "
+        f"/ {lat['p99']:.2f} s |",
+        f"| cold first job (service) | "
+        + (f"{svc['cold_first_job']:.2f} s |"
+           if svc["cold_first_job"] is not None else "n/a |"),
+        f"| warm job mean (service) | "
+        + (f"{svc['warm_mean']:.2f} s |"
+           if svc["warm_mean"] is not None else "n/a |"),
+        f"| cold-vs-warm delta | "
+        + (f"{svc['cold_warm_delta']:.2f} s |"
+           if svc["cold_warm_delta"] is not None else "n/a |"),
+        f"| kernel builds in warm jobs | {summary['warm_kernel_builds']} |",
+        DOCS_END,
+    ]
+    return "\n".join(lines)
+
+
+def update_docs(doc_path: str, summary: dict, workload: str) -> None:
+    """Replace the marked serve-loadtest block in `doc_path` (appends a
+    new block if the markers are absent)."""
+    block = render_markdown(summary, workload)
+    try:
+        with open(doc_path) as f:
+            text = f.read()
+    except OSError:
+        text = ""
+    if DOCS_BEGIN in text and DOCS_END in text:
+        head, rest = text.split(DOCS_BEGIN, 1)
+        _, tail = rest.split(DOCS_END, 1)
+        text = head + block + tail
+    else:
+        text = text.rstrip("\n") + "\n\n" + block + "\n"
+    with open(doc_path, "w") as f:
+        f.write(text)
+
+
+# -- CLI --------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="racon-tpu loadtest",
+        description="Drive a racon-tpu serve daemon with concurrent "
+        "synthetic polish jobs; report throughput + latency percentiles "
+        "+ the cold-vs-warm first-job delta.")
+    p.add_argument("--jobs", type=int, default=6)
+    p.add_argument("--clients", type=int, default=3)
+    p.add_argument("--port", type=int, default=None,
+                   help="drive an already-running daemon on this port "
+                   "(default: spawn a fresh one)")
+    p.add_argument("--state-dir", default=None,
+                   help="state dir for the spawned daemon (default: "
+                   "a temporary directory)")
+    p.add_argument("--backend", choices=("tpu", "cpu"), default="tpu")
+    p.add_argument("--mbp", type=float, default=0.01,
+                   help="synthetic workload megabases per job's draft "
+                   "(default 0.01)")
+    p.add_argument("--coverage", type=int, default=6)
+    p.add_argument("-w", "--window-length", type=int, default=500)
+    p.add_argument("--json", action="store_true",
+                   help="print the full summary JSON (per-job rows "
+                   "included) instead of the short text")
+    p.add_argument("--docs", metavar="PATH", default=None,
+                   help="rewrite the serve-loadtest block in this "
+                   "markdown file (docs/benchmarks.md)")
+    args = p.parse_args(argv)
+
+    import tempfile
+
+    from ..tools import simulate
+
+    workdir = args.state_dir or tempfile.mkdtemp(prefix="racon_serve_lt.")
+    data_dir = os.path.join(workdir, "data")
+    paths = simulate.generate(data_dir, mbp=args.mbp,
+                              coverage=args.coverage)
+    polish_args = {"window_length": args.window_length}
+    workload = (f"{args.mbp} Mbp draft x {args.coverage}x coverage, "
+                f"-w {args.window_length}, backend {args.backend}")
+
+    proc = None
+    if args.port is None:
+        proc = spawn_daemon(os.path.join(workdir, "state"), args.backend,
+                            window_length=args.window_length)
+        with open(os.path.join(workdir, "state", "serve.json")) as f:
+            port = json.load(f)["port"]
+    else:
+        port = args.port
+    try:
+        summary = run_loadtest(port, paths, args.jobs, args.clients,
+                               polish_args=polish_args)
+    finally:
+        if proc is not None:
+            try:
+                with ServeClient(port, timeout=10.0) as c:
+                    c.shutdown()
+                proc.wait(timeout=30)
+            except (OSError, ServeError, ValueError,
+                    subprocess.TimeoutExpired):
+                proc.kill()
+
+    if args.docs:
+        update_docs(args.docs, summary, workload)
+    if args.json:
+        print(json.dumps(summary, indent=1))
+    else:
+        slim = {k: v for k, v in summary.items() if k != "per_job"}
+        print(json.dumps(slim, indent=1))
+    return 0 if not summary["errors"] and \
+        summary["completed"] == summary["jobs"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

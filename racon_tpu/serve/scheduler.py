@@ -1,0 +1,503 @@
+"""Queue-based job scheduler: N concurrent submissions, one device set.
+
+Concurrency model: in-process polishes cannot overlap (the per-run
+runtime state the polisher constructors reset is module-global — see
+``polisher.reset_run_state``), so the **device lane** is one worker
+thread draining a queue through ``PolishSession.run_job``.  The **host
+lane** is a second worker running demoted jobs as ``python -m
+racon_tpu.cli`` subprocesses — the CPU oracle produces byte-identical
+output, so a demotion changes *where* a job runs, never *what* it
+returns.  This extends the kernel degradation lattice one level up:
+where a window falls ls → v2 → xla → host, a whole job falls
+device-lane → host-lane.
+
+Admission control bounds what the daemon will hold: a queue-depth cap on
+not-yet-running jobs, a max-jobs cap on everything unfinished, and a
+per-job window budget — a job whose estimated window count exceeds the
+budget is demoted to the host lane at submit time instead of occupying
+the device queue (an overloaded tier demotes work, it does not stall the
+queue).  Fairness is per-submitter round-robin: each submitter has its
+own FIFO; the scheduler serves submitters in rotation so one flooding
+client cannot starve the rest.
+
+Failure handling mirrors the lattice, too: a job that raises on the
+device lane is demoted to the host lane (recorded in its
+``demotions``); a host-lane failure is final and marks only that job
+failed — the daemon and the rest of the queue keep running.
+
+Persistence: the scheduler writes ``spec.json`` into the job directory
+at admission and ``result.json`` at any terminal state.  A daemon killed
+mid-run leaves specs without results; ``recover()`` re-queues them on
+restart, and the per-job journal (session.py) turns the re-run into a
+resume.  Graceful ``shutdown()`` finishes the running job, leaves queued
+jobs unpersisted-as-terminal, and lets the next daemon pick them up.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .session import (JobCancelled, JobSpec, PolishSession, serve_max_jobs,
+                      serve_queue_depth, serve_window_budget)
+
+LANES = ("device", "host")
+TERMINAL = ("done", "failed", "cancelled")
+
+
+class AdmissionError(RuntimeError):
+    """Submission rejected by admission control (queue full / at
+    capacity / invalid spec reuse).  The client sees the message; the
+    daemon state is untouched."""
+
+
+def estimate_windows(target_path: str, window_length: int) -> Optional[int]:
+    """Estimated window count for a draft: per contig,
+    ceil(len / window_length) — the same fixed-size chunking the window
+    builder applies.  None when the target cannot be sized cheaply
+    (non-FASTA, unreadable) — the budget check then lets it through."""
+    import gzip
+
+    opener = (gzip.open if target_path.lower().endswith(".gz") else open)
+    lens: List[int] = []
+    try:
+        with opener(target_path, "rt") as f:
+            for line in f:
+                if line.startswith(">"):
+                    lens.append(0)
+                elif line.startswith("@") and not lens:
+                    return None   # FASTQ (or garbage): not sized here
+                elif lens:
+                    lens[-1] += len(line.strip())
+    except (OSError, UnicodeDecodeError):
+        return None
+    if not lens:
+        return None
+    w = max(1, int(window_length))
+    return sum(math.ceil(n / w) for n in lens if n > 0)
+
+
+class Job:
+    """One scheduled job and its lifecycle:
+    queued -> running -> done | failed | cancelled."""
+
+    def __init__(self, spec: JobSpec, job_id: str):
+        self.spec = spec
+        self.id = job_id
+        self.state = "queued"
+        self.lane = "device"
+        self.result: Optional[dict] = None
+        self.error: Optional[str] = None
+        self.demotions: List[dict] = []
+        self.cancel = threading.Event()
+        self.done = threading.Event()
+        self.t_submit = time.monotonic()
+        self.t_start: Optional[float] = None
+        self.t_end: Optional[float] = None
+
+    def as_status(self) -> dict:
+        now = time.monotonic()
+        return {
+            "job_id": self.id,
+            "state": self.state,
+            "lane": self.lane,
+            "submitter": self.spec.submitter,
+            "demotions": list(self.demotions),
+            "error": self.error,
+            "queued_s": round((self.t_start or now) - self.t_submit, 4),
+            "running_s": (None if self.t_start is None else
+                          round((self.t_end or now) - self.t_start, 4)),
+        }
+
+
+class Scheduler:
+    def __init__(self, session: PolishSession,
+                 queue_depth: Optional[int] = None,
+                 max_jobs: Optional[int] = None,
+                 window_budget: Optional[int] = None,
+                 host_lane: bool = True):
+        self.session = session
+        self.queue_depth = (serve_queue_depth() if queue_depth is None
+                            else queue_depth)
+        self.max_jobs = serve_max_jobs() if max_jobs is None else max_jobs
+        self.window_budget = (serve_window_budget() if window_budget is None
+                              else window_budget)
+        self.host_lane = host_lane
+        self._jobs: Dict[str, Job] = {}
+        # lane -> submitter -> FIFO; _rr is the submitter rotation.
+        self._queues: Dict[str, Dict[str, deque]] = {ln: {} for ln in LANES}
+        self._rr: Dict[str, List[str]] = {ln: [] for ln in LANES}
+        self._cv = threading.Condition()
+        self._stop = False
+        self._counter = 0
+        self._workers: List[threading.Thread] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        for lane in LANES:
+            if lane == "host" and not self.host_lane:
+                continue
+            t = threading.Thread(target=self._worker, args=(lane,),
+                                 name=f"serve-{lane}-lane", daemon=True)
+            t.start()
+            self._workers.append(t)
+
+    def shutdown(self, wait: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop accepting work, finish the running job(s), exit the
+        workers.  Queued jobs keep their spec.json and get no
+        result.json — a restarted daemon re-queues them (recover()) and
+        their journals turn the re-run into a resume."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if wait:
+            for t in self._workers:
+                t.join(timeout)
+
+    def recover(self) -> List[str]:
+        """Re-queue every job directory holding a spec.json without a
+        result.json — the unfinished work of a previous daemon life.  A
+        spec that no longer admits (inputs deleted, invalid) is marked
+        failed so it cannot retry forever on every restart."""
+        jobs_root = os.path.join(self.session.workdir, "jobs")
+        recovered = []
+        for job_id in sorted(os.listdir(jobs_root) if
+                             os.path.isdir(jobs_root) else ()):
+            jd = os.path.join(jobs_root, job_id)
+            spec_path = os.path.join(jd, "spec.json")
+            if (not os.path.isfile(spec_path)
+                    or os.path.isfile(os.path.join(jd, "result.json"))):
+                continue
+            try:
+                with open(spec_path) as f:
+                    spec = JobSpec.from_dict(json.load(f))
+                spec.job_id = job_id
+                self.submit(spec)
+                recovered.append(job_id)
+            except (AdmissionError, ValueError, OSError,
+                    json.JSONDecodeError) as e:
+                job = Job(JobSpec("", "", "", job_id=job_id), job_id)
+                job.state = "failed"
+                job.error = f"recovery failed: {type(e).__name__}: {e}"
+                job.done.set()
+                with self._cv:
+                    self._jobs[job_id] = job
+                self._persist_result(job)
+                print(f"[racon_tpu::serve] WARNING: cannot recover job "
+                      f"{job_id}: {e}", file=sys.stderr)
+        return recovered
+
+    # -- submission / queries ----------------------------------------------
+
+    def submit(self, spec: JobSpec) -> Job:
+        spec.validate()
+        with self._cv:
+            if self._stop:
+                raise AdmissionError("daemon is shutting down")
+            unfinished = sum(1 for j in self._jobs.values()
+                             if j.state not in TERMINAL)
+            if unfinished >= self.max_jobs:
+                raise AdmissionError(
+                    f"at capacity: {unfinished} unfinished jobs "
+                    f"(RACON_TPU_SERVE_MAX_JOBS={self.max_jobs})")
+            queued = sum(len(q) for lane in self._queues.values()
+                         for q in lane.values())
+            if queued >= self.queue_depth:
+                raise AdmissionError(
+                    f"queue full: {queued} queued jobs "
+                    f"(RACON_TPU_SERVE_QUEUE_DEPTH={self.queue_depth})")
+            job_id = spec.job_id
+            if job_id:
+                prior = self._jobs.get(job_id)
+                if prior is not None and prior.state not in TERMINAL:
+                    raise AdmissionError(f"job id {job_id!r} is already "
+                                         f"{prior.state}")
+            else:
+                while True:
+                    job_id = f"job{self._counter:04d}"
+                    self._counter += 1
+                    if job_id not in self._jobs:
+                        break
+                spec.job_id = job_id
+            job = Job(spec, job_id)
+            lane = self._admission_lane(job)
+            self._jobs[job_id] = job
+            self._enqueue(lane, job)
+            self._persist_spec(job)
+            self._cv.notify_all()
+            return job
+
+    def _admission_lane(self, job: Job) -> str:
+        spec = job.spec
+        if not self.host_lane:
+            return "device"
+        if (spec.backend or self.session.backend) == "cpu":
+            job.lane = "host"
+            return "host"
+        budget = spec.window_budget or self.window_budget
+        if budget > 0:
+            w = spec.polish_args()["window_length"]
+            est = estimate_windows(spec.target, w)
+            if est is not None and est > budget:
+                job.lane = "host"
+                job.demotions.append({
+                    "from": "device", "to": "host",
+                    "cause": f"window budget: ~{est} windows > "
+                             f"budget {budget}"})
+                return "host"
+        return "device"
+
+    def get(self, job_id: str) -> Job:
+        with self._cv:
+            job = self._jobs.get(job_id)
+        if job is None:
+            raise KeyError(f"unknown job id {job_id!r}")
+        return job
+
+    def cancel(self, job_id: str) -> dict:
+        """Cancel a job.  Queued: removed immediately.  Running: the
+        cancel event is honored at the next phase boundary (device lane)
+        or kills the subprocess (host lane); a device job that reaches
+        completion first stays done — cancellation is best-effort once
+        work is on the device."""
+        job = self.get(job_id)
+        with self._cv:
+            if job.state == "queued":
+                for lane in LANES:
+                    q = self._queues[lane].get(job.spec.submitter)
+                    if q is not None and job in q:
+                        q.remove(job)
+                job.state = "cancelled"
+                job.error = "cancelled while queued"
+                job.t_end = time.monotonic()
+                job.done.set()
+                self._persist_result(job)
+                return job.as_status()
+        job.cancel.set()
+        return job.as_status()
+
+    def stats(self) -> dict:
+        with self._cv:
+            by_state: Dict[str, int] = {}
+            for j in self._jobs.values():
+                by_state[j.state] = by_state.get(j.state, 0) + 1
+            queued = {lane: sum(len(q) for q in lanes.values())
+                      for lane, lanes in self._queues.items()}
+        return {
+            "jobs": by_state,
+            "queued": queued,
+            "queue_depth": self.queue_depth,
+            "max_jobs": self.max_jobs,
+            "window_budget": self.window_budget,
+            "session": self.session.stats(),
+        }
+
+    # -- queue mechanics (call with self._cv held) -------------------------
+
+    def _enqueue(self, lane: str, job: Job) -> None:
+        sub = job.spec.submitter
+        q = self._queues[lane].get(sub)
+        if q is None:
+            q = self._queues[lane][sub] = deque()
+            self._rr[lane].append(sub)
+        q.append(job)
+
+    def _pop(self, lane: str) -> Optional[Job]:
+        """Next job for a lane: first submitter in the rotation with
+        queued work; the served submitter moves to the back, so bursts
+        from one client interleave with everyone else's jobs."""
+        rr = self._rr[lane]
+        for i, sub in enumerate(rr):
+            q = self._queues[lane][sub]
+            if q:
+                rr.append(rr.pop(i))
+                return q.popleft()
+        return None
+
+    # -- workers -----------------------------------------------------------
+
+    def _worker(self, lane: str) -> None:
+        while True:
+            with self._cv:
+                job = self._pop(lane)
+                while job is None:
+                    if self._stop:
+                        return
+                    self._cv.wait(0.2)
+                    job = self._pop(lane)
+                job.state = "running"
+                job.lane = lane
+                job.t_start = time.monotonic()
+            try:
+                if lane == "device":
+                    result = self.session.run_job(job.spec,
+                                                  cancel_event=job.cancel)
+                else:
+                    result = self._run_host(job)
+            except JobCancelled:
+                self._finish(job, "cancelled", error="cancelled mid-run")
+            except Exception as e:  # noqa: BLE001 — the job absorbs the
+                # failure (lattice-of-last-resort); the daemon and the
+                # rest of the queue keep serving
+                if (lane == "device" and self.host_lane
+                        and not job.cancel.is_set()):
+                    self._demote(job, e)
+                else:
+                    self._finish(job, "failed",
+                                 error=f"{type(e).__name__}: {e}")
+            else:
+                self._finish(job, "done", result=result)
+
+    def _demote(self, job: Job, exc: BaseException) -> None:
+        """Device-lane failure: re-queue on the host lane (the job-level
+        degradation step).  Output stays byte-identical — the host lane
+        is the oracle path."""
+        job.demotions.append({
+            "from": "device", "to": "host",
+            "cause": f"{type(exc).__name__}: {exc}"})
+        with self._cv:
+            if self._stop:
+                job.state = "queued"   # next daemon life recovers it
+                self._cv.notify_all()
+                return
+            job.state = "queued"
+            self._enqueue("host", job)
+            self._cv.notify_all()
+
+    def _finish(self, job: Job, state: str, result: Optional[dict] = None,
+                error: Optional[str] = None) -> None:
+        with self._cv:
+            job.state = state
+            job.result = result
+            job.error = error
+            job.t_end = time.monotonic()
+            job.done.set()
+            self._cv.notify_all()
+        self._persist_result(job)
+
+    # -- host lane ---------------------------------------------------------
+
+    def _run_host(self, job: Job) -> dict:
+        """Run one job as a host-path CLI subprocess.  Same flags as a
+        user-run CLI invocation (byte-identical output), its own
+        journal (cpu-fingerprinted) and per-request trace, stdout
+        written to a .part file and renamed only on success."""
+        spec = job.spec
+        a = spec.polish_args()
+        jd = self.session.job_dir(job.id)
+        os.makedirs(jd, exist_ok=True)
+        out_path = os.path.join(jd, "polished.fasta")
+        part_path = out_path + ".part"
+        report_path = os.path.join(jd, "report.json")
+        stderr_path = os.path.join(jd, "host.stderr.log")
+        cmd = [sys.executable, "-m", "racon_tpu.cli",
+               "-w", str(a["window_length"]),
+               "-q", str(a["quality_threshold"]),
+               "-e", str(a["error_threshold"]),
+               "-m", str(a["match"]), "-x", str(a["mismatch"]),
+               "-g", str(a["gap"]), "-t", str(a["num_threads"]),
+               "--report", report_path,
+               "--resume-journal", os.path.join(jd, "journal.cpu.jsonl"),
+               "--trace", os.path.join(jd, "trace.json")]
+        if not a["trim"]:
+            cmd.append("--no-trimming")
+        if a["fragment_correction"]:
+            cmd.append("-f")
+        if spec.include_unpolished:
+            cmd.append("-u")
+        cmd += [spec.sequences, spec.overlaps, spec.target]
+
+        t0 = time.monotonic()
+        with open(part_path, "w") as out_f, open(stderr_path, "w") as err_f:
+            proc = subprocess.Popen(cmd, stdout=out_f, stderr=err_f)
+            while True:
+                try:
+                    rc = proc.wait(timeout=0.2)
+                    break
+                except subprocess.TimeoutExpired:
+                    if job.cancel.is_set():
+                        proc.kill()
+                        proc.wait()
+                        raise JobCancelled(job.id) from None
+        if rc != 0:
+            tail = ""
+            try:
+                with open(stderr_path) as f:
+                    tail = f.read()[-400:].strip()
+            except OSError:
+                pass
+            raise RuntimeError(f"host lane exited {rc}: {tail}")
+        os.replace(part_path, out_path)
+
+        records = polished_bp = 0
+        with open(out_path) as f:
+            for line in f:
+                if line.startswith(">"):
+                    records += 1
+                else:
+                    polished_bp += len(line.strip())
+        replayed = 0
+        try:
+            with open(report_path) as f:
+                rep = json.load(f)
+            replayed = sum(ph.get("served", {}).get("journal", 0)
+                           for ph in rep.get("phases", {}).values())
+        except (OSError, json.JSONDecodeError, AttributeError):
+            pass
+        return {
+            "job_id": job.id,
+            "backend": "cpu",
+            "cold": False,
+            "wall_s": round(time.monotonic() - t0, 4),
+            "records": records,
+            "polished_bp": polished_bp,
+            "kernel_builds": 0,
+            "journal_replayed": replayed,
+            "output": out_path,
+            "report": report_path,
+            "trace": os.path.join(jd, "trace.json"),
+            "summary": None,
+        }
+
+    # -- persistence (job dir = crash-safe source of truth) ----------------
+
+    def _persist_spec(self, job: Job) -> None:
+        jd = self.session.job_dir(job.id)
+        try:
+            os.makedirs(jd, exist_ok=True)
+            with open(os.path.join(jd, "spec.json"), "w") as f:
+                json.dump(job.spec.as_dict(), f, indent=1)
+                f.write("\n")
+        except OSError as e:
+            print(f"[racon_tpu::serve] WARNING: cannot persist spec for "
+                  f"{job.id}: {e}", file=sys.stderr)
+
+    def _persist_result(self, job: Job) -> None:
+        jd = self.session.job_dir(job.id)
+        doc = {
+            "job_id": job.id,
+            "state": job.state,
+            "lane": job.lane,
+            "result": job.result,
+            "error": job.error,
+            "demotions": list(job.demotions),
+        }
+        try:
+            os.makedirs(jd, exist_ok=True)
+            tmp = os.path.join(jd, "result.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+                f.write("\n")
+            os.replace(tmp, os.path.join(jd, "result.json"))
+        except OSError as e:
+            print(f"[racon_tpu::serve] WARNING: cannot persist result for "
+                  f"{job.id}: {e}", file=sys.stderr)

@@ -1,0 +1,209 @@
+"""The `racon-tpu serve` daemon: localhost TCP, newline-JSON protocol.
+
+One JSON object per line in each direction.  Requests carry an ``op``:
+
+* ``ping``     -> ``{"ok": true, "pid": ..., "backend": ...}``
+* ``submit``   -> admit a job (fields of serve.session.JobSpec);
+  response carries the assigned ``job_id``.
+* ``status``   -> job lifecycle snapshot (state, lane, demotions).
+* ``result``   -> terminal outcome; ``"wait": true`` blocks (this
+  connection's thread only) until the job finishes or ``timeout``.
+* ``cancel``   -> cancel queued immediately / running best-effort.
+* ``stats``    -> scheduler + session counters.
+* ``shutdown`` -> acknowledge, then stop the daemon gracefully.
+
+Errors never kill the daemon: a malformed line gets
+``{"ok": false, "error": ...}`` on that connection; a client that
+disconnects mid-job only loses its socket — the job keeps running and
+its result stays queryable by id from any new connection.  The bound
+port is written to ``<state_dir>/serve.json`` so clients (and the
+load-test harness) can find a daemon started with port 0.
+
+Restart story: on start the daemon re-queues every job directory with a
+spec but no result (scheduler.recover) — combined with the per-job
+journals, a daemon preempted mid-job resumes the job instead of
+recomputing it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+from typing import Optional
+
+from .scheduler import AdmissionError, Scheduler
+from .session import JobSpec, PolishSession, serve_port
+
+#: Protocol guard: one request line must fit comfortably in memory.
+MAX_LINE = 1 << 20
+
+
+class ServeDaemon:
+    def __init__(self, state_dir: str, backend: str = "tpu",
+                 port: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 max_jobs: Optional[int] = None,
+                 window_budget: Optional[int] = None,
+                 warm: Optional[bool] = None,
+                 warm_window_lengths=(500,),
+                 warm_scores=(3, -5, -4),
+                 host_lane: bool = True):
+        self.state_dir = state_dir
+        os.makedirs(state_dir, exist_ok=True)
+        self.session = PolishSession(state_dir, backend=backend)
+        self.scheduler = Scheduler(self.session, queue_depth=queue_depth,
+                                   max_jobs=max_jobs,
+                                   window_budget=window_budget,
+                                   host_lane=host_lane)
+        self._warm = warm
+        self._warm_window_lengths = warm_window_lengths
+        self._warm_scores = warm_scores
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", serve_port() if port is None
+                         else port))
+        self.port = self._sock.getsockname()[1]
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Warm the kernels, recover unfinished jobs, start accepting."""
+        from .session import serve_warmup_enabled
+
+        with open(os.path.join(self.state_dir, "serve.json"), "w") as f:
+            json.dump({"host": "127.0.0.1", "port": self.port,
+                       "pid": os.getpid(),
+                       "backend": self.session.backend}, f)
+            f.write("\n")
+        warm = serve_warmup_enabled() if self._warm is None else self._warm
+        if warm:
+            m, x, g = self._warm_scores
+            wall = self.session.warm(self._warm_window_lengths, m, x, g)
+            if wall:
+                print(f"[racon_tpu::serve] warmed consensus geometries "
+                      f"{sorted(self.session.warmed)} in {wall:.2f}s",
+                      file=sys.stderr)
+        self.scheduler.start()
+        recovered = self.scheduler.recover()
+        if recovered:
+            print(f"[racon_tpu::serve] recovered {len(recovered)} "
+                  f"unfinished job(s): {', '.join(recovered)}",
+                  file=sys.stderr)
+        self._sock.listen(16)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="serve-accept", daemon=True)
+        self._accept_thread.start()
+
+    def serve_forever(self) -> None:
+        self.start()
+        print(f"[racon_tpu::serve] listening on 127.0.0.1:{self.port} "
+              f"(state: {self.state_dir}, backend: {self.session.backend})",
+              file=sys.stderr)
+        self._stopping.wait()
+        self.scheduler.shutdown(wait=True)
+
+    def stop(self, wait: bool = True) -> None:
+        if not self._stopping.is_set():
+            self._stopping.set()
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if wait:
+            self.scheduler.shutdown(wait=True)
+
+    # -- accept / connection handling --------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return   # socket closed by stop()
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 name="serve-conn", daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        """One thread per connection; a client vanishing mid-exchange
+        closes only this socket."""
+        try:
+            f = conn.makefile("rwb")
+            while True:
+                line = f.readline(MAX_LINE)
+                if not line:
+                    return
+                try:
+                    req = json.loads(line)
+                    if not isinstance(req, dict):
+                        raise ValueError("request must be a JSON object")
+                    resp = self._dispatch(req)
+                except AdmissionError as e:
+                    resp = {"ok": False, "error": str(e),
+                            "rejected": "admission"}
+                except (ValueError, KeyError, TypeError,
+                        json.JSONDecodeError) as e:
+                    resp = {"ok": False, "error": f"{e}"}
+                except Exception as e:  # noqa: BLE001 — one bad request
+                    # must not take down the connection (or the daemon)
+                    resp = {"ok": False,
+                            "error": f"{type(e).__name__}: {e}"}
+                f.write(json.dumps(resp).encode() + b"\n")
+                f.flush()
+                if resp.get("bye"):
+                    self.stop(wait=False)
+                    return
+        except (OSError, BrokenPipeError, ConnectionResetError):
+            pass   # client went away; the daemon does not care
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- protocol ----------------------------------------------------------
+
+    def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True, "pid": os.getpid(),
+                    "backend": self.session.backend, "port": self.port}
+        if op == "submit":
+            spec = JobSpec.from_dict(
+                {k: v for k, v in req.items() if k != "op"})
+            job = self.scheduler.submit(spec)
+            return {"ok": True, "job_id": job.id, "lane": job.lane,
+                    "demotions": list(job.demotions)}
+        if op == "status":
+            job = self.scheduler.get(str(req["job_id"]))
+            return {"ok": True, **job.as_status()}
+        if op == "result":
+            job = self.scheduler.get(str(req["job_id"]))
+            if req.get("wait"):
+                timeout = req.get("timeout")
+                if not job.done.wait(None if timeout is None
+                                     else float(timeout)):
+                    # status last-but-error-wins: as_status()'s error
+                    # field is None for a live job and must not clobber
+                    # the timeout message
+                    return {**job.as_status(), "ok": False,
+                            "error": f"timeout waiting for {job.id}"}
+            if not job.done.is_set():
+                return {**job.as_status(), "ok": False,
+                        "error": f"job {job.id} is {job.state}"}
+            return {**job.as_status(), "ok": job.state == "done",
+                    "result": job.result}
+        if op == "cancel":
+            return {"ok": True,
+                    **self.scheduler.cancel(str(req["job_id"]))}
+        if op == "stats":
+            return {"ok": True, **self.scheduler.stats()}
+        if op == "shutdown":
+            return {"ok": True, "bye": True}
+        raise ValueError(f"unknown op {op!r}; expected one of ping/submit/"
+                         f"status/result/cancel/stats/shutdown")

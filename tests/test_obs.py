@@ -41,9 +41,12 @@ def test_tracer_thread_pool_nesting():
 
     def work(k):
         gate.wait()
-        t0 = 1000 * k
-        tr.add_complete(f"outer.{k}", t0, t0 + 500, idx=k)
-        tr.add_complete(f"inner.{k}", t0 + 100, t0 + 200)
+        # µs-aligned ns stamps: _ts_us floor-divides (t - epoch) by 1000,
+        # so sub-µs offsets would make the rounded nesting depend on the
+        # epoch's ns remainder (and the durations collapse to 0)
+        t0 = 1_000_000 * k
+        tr.add_complete(f"outer.{k}", t0, t0 + 500_000, idx=k)
+        tr.add_complete(f"inner.{k}", t0 + 100_000, t0 + 200_000)
         gate.wait()
 
     threads = [threading.Thread(target=work, args=(k,), name=f"w{k}")
