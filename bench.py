@@ -663,6 +663,84 @@ def serve_profile(jobs: int = 4, clients: int = 2) -> int:
     return 0 if summary["completed"] == summary["jobs"] else 1
 
 
+def distrib_profile(workers: int = 3) -> int:
+    """`python bench.py distrib`: benchmark the multi-process chunk
+    fleet (racon_tpu/distrib).
+
+    Runs the standard bench dataset through a Coordinator driving
+    `workers` localhost worker processes on the cpu backend (the chunk
+    workers run the host-oracle path — the fleet's scaling axis is
+    processes, not kernels; the device story is serve's), and stamps
+    polished Mbp/s over the gathered output plus the fleet accounting —
+    chunks, serving mix, re-dispatch / speculation / duplicate /
+    journal-resume counts — under "distrib".  The `profile:
+    distrib-<PROFILE>` field keeps it its own trend series for the
+    `obs bench` regression gate.  vs_baseline is null: byte-identity to
+    the serial CLI is CI's cmp gate, not a throughput ratio."""
+    import tempfile
+
+    from racon_tpu.distrib import Coordinator
+
+    paths = dataset()
+    workdir = tempfile.mkdtemp(prefix="racon_tpu_bench_distrib.")
+    out_path = os.path.join(workdir, "polished.fasta")
+    t0 = time.monotonic()
+    coord = Coordinator(paths["reads"], paths["overlaps"], paths["draft"],
+                        workdir, args=dict(ARGS), backend="cpu",
+                        workers=workers)
+    result = coord.run(out_path, timeout=1800)
+    wall = time.monotonic() - t0
+    polished_bp = 0
+    with open(out_path) as f:
+        for line in f:
+            if not line.startswith(">"):
+                polished_bp += len(line.strip())
+    value = polished_bp / 1e6 / wall if wall > 0 else 0.0
+    counters = result["counters"]
+    distrib_stats = {
+        "workers": workers,
+        "chunks": result["chunks"],
+        "served": result["served"],
+        "dispatches": counters.get("dispatches", 0),
+        "redispatches": counters.get("redispatches", 0),
+        "speculative": counters.get("speculative", 0),
+        "duplicates": counters.get("duplicates", 0),
+        "journal_replayed": counters.get("journal_replayed", 0),
+        "workers_dead": counters.get("workers_dead", 0),
+        "degradations": len(result["degradations"]),
+    }
+    entry = {
+        "metric": f"distrib: polished Mbp/sec ({_WORKLOAD} {MBP} Mbp "
+                  f"{COVERAGE}x, {INPUT.upper()}, "
+                  f"w={ARGS['window_length']}, {workers} workers/"
+                  f"{result['chunks']} chunks, end-to-end)",
+        "value": round(value, 4),
+        "unit": "Mbp/s",
+        # no paired oracle run in distrib mode — explicit nulls keep
+        # normalize_entry a fixed point on fresh entries
+        "vs_baseline": None,
+        "cost_model": None,
+        "pack_split": None,
+        "distrib": distrib_stats,
+    }
+    assert normalize_entry(dict(entry)) == entry, \
+        "distrib bench entry must be a normalize_entry fixed point"
+    log_device_measurement({
+        "mbp": MBP, "input": INPUT, "profile": f"distrib-{PROFILE}",
+        "value": round(value, 4), "vs_baseline": None,
+        "kernel": "host", "distrib": distrib_stats,
+        "cost_model": None, "pack_split": None,
+    })
+    print(json.dumps(entry))
+    served_total = sum(result["served"].values())
+    print(f"[bench] distrib: {served_total}/{result['chunks']} chunks "
+          f"({result['served']}), wall {wall:.1f}s, "
+          f"redispatches {distrib_stats['redispatches']}, "
+          f"replayed {distrib_stats['journal_replayed']}",
+          file=sys.stderr)
+    return 0 if served_total == result["chunks"] else 1
+
+
 def _opportunistic_golden(tier, timeout_s: int = 900):
     """Healthy chip in hand: also re-measure the λ device golden, bounded.
 
@@ -707,4 +785,6 @@ def _opportunistic_golden(tier, timeout_s: int = 900):
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "serve":
         sys.exit(serve_profile())
+    if len(sys.argv) > 1 and sys.argv[1] == "distrib":
+        sys.exit(distrib_profile())
     main()

@@ -4,6 +4,8 @@ of the CUDA ones.
 
 Usage: racon-tpu [options ...] <sequences> <overlaps> <target sequences>
        racon-tpu serve [options ...]   (resident polishing daemon)
+       racon-tpu distrib [options ...] <sequences> <overlaps> <targets>
+                                       (multi-process chunk-worker fleet)
 """
 
 from __future__ import annotations
@@ -22,7 +24,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "assembly of long uncorrected reads",
         epilog="subcommands: `racon-tpu serve` runs the resident "
         "polishing daemon (hot kernels, job queue, preemption-safe "
-        "jobs — see `racon-tpu serve --help`).",
+        "jobs — see `racon-tpu serve --help`); `racon-tpu distrib` "
+        "polishes with a fault-tolerant multi-process chunk-worker "
+        "fleet (leases, heartbeats, journal resume — see `racon-tpu "
+        "distrib --help`).",
     )
     p.add_argument("sequences", help="FASTA/FASTQ file (optionally gzipped) "
                    "containing sequences used for correction")
@@ -94,6 +99,9 @@ def main(argv=None) -> int:
     if argv and argv[0] == "serve":
         from .serve.__main__ import main as serve_main
         return serve_main(argv[1:])
+    if argv and argv[0] == "distrib":
+        from .distrib.__main__ import main as distrib_main
+        return distrib_main(argv[1:])
     args = build_arg_parser().parse_args(argv)
 
     from .native import NativeError

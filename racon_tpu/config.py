@@ -136,6 +136,30 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
        "serve per-job window budget: jobs whose estimated window count "
        "exceeds it are demoted to the host lane instead of occupying "
        "the device queue (0 = unlimited)"),
+    # -- distributed-fleet knobs ------------------------------------------
+    _k("RACON_TPU_DISTRIB_WORKERS", "2", "int",
+       "`racon-tpu distrib` fleet size: chunk-worker processes the "
+       "coordinator spawns (CLI --workers overrides)"),
+    _k("RACON_TPU_DISTRIB_LEASE_TTL", "10", "float",
+       "distrib chunk-lease TTL in seconds: a lease not renewed by a "
+       "heartbeat within the TTL expires and the chunk is re-dispatched"),
+    _k("RACON_TPU_DISTRIB_HEARTBEAT", None, "float",
+       "distrib worker heartbeat interval in seconds (default: lease "
+       "TTL / 3)"),
+    _k("RACON_TPU_DISTRIB_RETRY_BASE", "0.25", "float",
+       "distrib retry backoff base in seconds: attempt N of a chunk "
+       "waits base * 2^(N-1) before becoming eligible again"),
+    _k("RACON_TPU_DISTRIB_MAX_RETRIES", "3", "int",
+       "distrib per-chunk failure budget: a chunk failing more than "
+       "this many times falls back to local (in-coordinator) execution"),
+    _k("RACON_TPU_DISTRIB_SPECULATE", "2.5", "float",
+       "distrib straggler threshold: a running chunk whose elapsed time "
+       "exceeds this factor x the median completed-chunk wall gets a "
+       "speculative duplicate on an idle worker (0 disables)"),
+    _k("RACON_TPU_DISTRIB_FAULT_WORKER", "0", "int",
+       "distrib fault scoping: the worker index that inherits "
+       "RACON_TPU_FAULT (other workers get it stripped), so chaos tests "
+       "kill exactly one worker", scope="test"),
     # -- test / bench knobs ----------------------------------------------
     _k("RACON_TPU_HW_TESTS", None, "bool",
        "assert exact on-hardware pins against a real TPU backend",

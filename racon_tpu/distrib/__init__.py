@@ -1,0 +1,16 @@
+"""Fault-tolerant multi-process polishing: `racon-tpu distrib`.
+
+A coordinator (coordinator.py) splits the target FASTA into contig
+chunks and farms them out to a fleet of worker processes (worker.py)
+over the serve wire format, with lease-based assignment, heartbeat
+renewal, exponential-backoff re-dispatch, speculative straggler
+duplication, per-chunk journal resume, and a fleet→local degradation
+rung when the fleet shrinks to zero.  Ordered gather keeps the output
+byte-identical to a single-process run.  See docs/architecture.md,
+"Distributed polishing".
+"""
+
+from .common import WireError
+from .coordinator import Chunk, Coordinator, Lease
+
+__all__ = ["Chunk", "Coordinator", "Lease", "WireError"]

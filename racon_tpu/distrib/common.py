@@ -1,0 +1,61 @@
+"""Shared pieces of the distrib coordinator/worker pair: the knob
+accessors (registered in racon_tpu/config.py; README has the docs rows)
+and the blocking request/response helper over the serve wire format
+(serve/protocol.py — one JSON object per line)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import config
+from ..serve.protocol import read_message, write_message
+
+
+class WireError(ConnectionError):
+    """The peer closed the connection or answered ``ok: false``."""
+
+
+def rpc(f, msg: dict) -> dict:
+    """One request/response exchange on a buffered socket file; raises
+    WireError on EOF or an ``ok: false`` answer."""
+    write_message(f, msg)
+    resp = read_message(f)
+    if resp is None:
+        raise WireError(f"peer closed the connection (op "
+                        f"{msg.get('op')!r})")
+    if not resp.get("ok"):
+        raise WireError(str(resp.get("error", "request failed")))
+    return resp
+
+
+def distrib_workers() -> int:
+    return config.get_int("RACON_TPU_DISTRIB_WORKERS")
+
+
+def distrib_lease_ttl() -> float:
+    return config.get_float("RACON_TPU_DISTRIB_LEASE_TTL")
+
+
+def distrib_heartbeat(ttl: Optional[float] = None) -> float:
+    """Heartbeat interval; defaults to a third of the lease TTL so two
+    missed beats still renew before the lease expires."""
+    raw = config.get_raw("RACON_TPU_DISTRIB_HEARTBEAT")
+    if raw:
+        return float(raw)
+    return (distrib_lease_ttl() if ttl is None else ttl) / 3.0
+
+
+def distrib_retry_base() -> float:
+    return config.get_float("RACON_TPU_DISTRIB_RETRY_BASE")
+
+
+def distrib_max_retries() -> int:
+    return config.get_int("RACON_TPU_DISTRIB_MAX_RETRIES")
+
+
+def distrib_speculate() -> float:
+    return config.get_float("RACON_TPU_DISTRIB_SPECULATE")
+
+
+def distrib_fault_worker() -> int:
+    return config.get_int("RACON_TPU_DISTRIB_FAULT_WORKER")

@@ -1,0 +1,654 @@
+"""The `racon-tpu distrib` coordinator: chunk fleet with leases.
+
+The coordinator splits the target FASTA into contiguous contig chunks
+(``polisher._split_fasta`` — the same base-balanced split the phase
+pipeline uses, so chunked output concatenates byte-identically) and
+farms them out to a fleet of worker processes over the serve wire
+format (newline-JSON over localhost TCP, serve/protocol.py).  Workers
+are clients: they connect, say ``hello``, then loop ``fetch`` →
+polish → ``result``; a background thread per in-flight chunk sends
+``heartbeat`` renewals on a second connection.
+
+Robustness model (the headline, not an afterthought):
+
+* **Leases.**  Every assignment carries a TTL lease.  A heartbeat renews
+  it; a lease that outlives its TTL expires and the chunk re-queues with
+  exponential backoff (``RACON_TPU_DISTRIB_RETRY_BASE * 2^n``).  A
+  worker connection EOF (crash, SIGKILL) expires all of its leases
+  immediately — death is detected at socket speed, not TTL speed.
+* **Re-dispatch.**  An expired/failed chunk prefers a worker that has
+  not attempted it.  The per-chunk journal lives on the shared
+  filesystem, so when the previous holder is *known dead* the re-run
+  resumes the journaled prefix instead of recomputing
+  (resilience/journal.py); a holder that is merely unresponsive keeps
+  journal ownership and the re-run writes a fresh side journal — two
+  live writers never share a journal file.
+* **Speculation.**  An idle worker with no pending work duplicates the
+  longest-running chunk once it exceeds ``RACON_TPU_DISTRIB_SPECULATE``
+  × the median completed-chunk wall.  The first result to arrive wins;
+  later duplicates are discarded deterministically (the chunk is already
+  ``done``) and counted.
+* **Fleet → local.**  The degradation lattice's next rung up: a chunk
+  that exhausts its retry budget — or every chunk, when the fleet
+  shrinks to zero — is executed by the coordinator itself through the
+  host-oracle CLI (the same demotion target as the serve host lane),
+  recorded as a ``fleet → local`` degradation in the run report.
+
+Ordered gather: results install per chunk index and concatenate in
+order, so the polished FASTA is byte-identical to a single-process run
+(pinned by tests/test_distrib.py and the CI chaos job's ``cmp`` gate).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import statistics
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import obs
+from ..polisher import _split_fasta
+from ..resilience import faults
+from ..resilience.report import PhaseReport, RunReport
+from ..serve.protocol import read_message, write_message
+from ..serve.session import POLISH_ARG_DEFAULTS
+from .common import (distrib_fault_worker, distrib_heartbeat,
+                     distrib_lease_ttl, distrib_max_retries,
+                     distrib_retry_base, distrib_speculate, distrib_workers)
+
+#: Environment a worker must NOT inherit: per-run artifact knobs would
+#: make every worker clobber the coordinator's trace/report/journal.
+_SCOPED_KNOBS = ("RACON_TPU_TRACE", "RACON_TPU_TRACE_DEVICE",
+                 "RACON_TPU_METRICS", "RACON_TPU_REPORT",
+                 "RACON_TPU_JOURNAL")
+
+#: Fleet tiers, lattice order (fleet is the device-analogue; local is
+#: the coordinator-run oracle floor).
+TIERS = ("fleet", "local")
+
+
+class Lease:
+    __slots__ = ("worker", "attempt", "deadline", "t_start", "canonical")
+
+    def __init__(self, worker: int, attempt: int, ttl: float,
+                 canonical: bool):
+        self.worker = worker
+        self.attempt = attempt
+        self.t_start = time.monotonic()
+        self.deadline = self.t_start + ttl
+        self.canonical = canonical   # holds the chunk's primary journal
+
+
+class Chunk:
+    """One contig chunk and its dispatch lifecycle."""
+
+    def __init__(self, index: int, target: str, chunk_dir: str):
+        self.index = index
+        self.target = target
+        self.dir = chunk_dir
+        self.journal = os.path.join(chunk_dir, "journal.jsonl")
+        self.state = "pending"        # pending | running | done
+        self.local = False            # demoted to coordinator execution
+        self.attempts = 0
+        self.failures = 0
+        self.next_eligible = 0.0
+        self.leases: Dict[int, Lease] = {}
+        self.tried = set()            # worker ids that have attempted
+        self.journal_held = False     # a (possibly live) writer owns it
+        self.output: Optional[str] = None
+        self.stats: dict = {}
+        self.served_by: Optional[str] = None
+
+
+class Coordinator:
+    def __init__(self, sequences: str, overlaps: str, target: str,
+                 workdir: str, args: Optional[dict] = None,
+                 include_unpolished: bool = False, backend: str = "cpu",
+                 workers: Optional[int] = None,
+                 chunks_hint: Optional[int] = None,
+                 lease_ttl: Optional[float] = None,
+                 max_retries: Optional[int] = None,
+                 trace_path: Optional[str] = None,
+                 report_path: Optional[str] = None):
+        self.sequences = sequences
+        self.overlaps = overlaps
+        self.target = target
+        self.workdir = workdir
+        self.args = dict(POLISH_ARG_DEFAULTS)
+        self.args.update(args or {})
+        self.include_unpolished = include_unpolished
+        self.backend = backend
+        self.n_workers = distrib_workers() if workers is None else workers
+        self.chunks_hint = chunks_hint
+        self.lease_ttl = (distrib_lease_ttl() if lease_ttl is None
+                          else lease_ttl)
+        self.max_retries = (distrib_max_retries() if max_retries is None
+                            else max_retries)
+        self.trace_path = trace_path
+        self.report_path = report_path
+
+        self.chunks: List[Chunk] = []
+        self.counters: Dict[str, int] = {}
+        self.completed_walls: List[float] = []
+        self.report = RunReport()
+        self.phase = PhaseReport("distrib", TIERS)
+        self.report.attach(self.phase)
+        self._cv = threading.Condition()
+        self._stopping = False
+        self._degraded = False
+        self._procs: Dict[int, subprocess.Popen] = {}
+        self._dead_workers = set()
+        self._sock: Optional[socket.socket] = None
+        self.port = 0
+
+    # -- counters (mirrored into obs so the coordinator trace carries
+    # -- distrib.* series even though the python dict is the source of
+    # -- truth when tracing is disarmed) -----------------------------------
+
+    def _count(self, name: str, n: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+        obs.count(f"distrib.{name}", n)
+
+    # -- setup -------------------------------------------------------------
+
+    def _layout(self) -> None:
+        chunks_dir = os.path.join(self.workdir, "chunks")
+        os.makedirs(chunks_dir, exist_ok=True)
+        paths = _split_fasta(self.target, self.chunks_hint or
+                             max(2, 2 * self.n_workers), chunks_dir)
+        if paths is None:
+            # single contig / non-FASTA: one chunk, the whole target
+            paths = [self.target]
+        for i, p in enumerate(paths):
+            cd = os.path.join(chunks_dir, f"chunk{i:03d}")
+            os.makedirs(cd, exist_ok=True)
+            self.chunks.append(Chunk(i, p, cd))
+        self.phase.total = len(self.chunks)
+
+    def _listen(self) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(16)
+        t = threading.Thread(target=self._accept_loop,
+                             name="distrib-accept", daemon=True)
+        t.start()
+
+    def _worker_env(self, index: int) -> dict:
+        env = dict(os.environ)
+        for k in _SCOPED_KNOBS:
+            env.pop(k, None)
+        # fault scoping: exactly one worker inherits RACON_TPU_FAULT, so
+        # a chaos run kills a known worker instead of the whole fleet
+        if "RACON_TPU_FAULT" in env and index != distrib_fault_worker():
+            env.pop("RACON_TPU_FAULT", None)
+        return env
+
+    def _spawn_fleet(self) -> None:
+        logs_dir = os.path.join(self.workdir, "workers")
+        os.makedirs(logs_dir, exist_ok=True)
+        for i in range(self.n_workers):
+            try:
+                faults.check("worker.spawn")
+                log = open(os.path.join(logs_dir, f"worker{i}.log"), "w")
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "racon_tpu.distrib.worker",
+                     "--port", str(self.port), "--worker", str(i)],
+                    env=self._worker_env(i), stdout=log, stderr=log)
+                log.close()
+            except Exception as e:  # noqa: BLE001 — a spawn failure
+                # (injected or real) shrinks the fleet; it must not kill
+                # the run, which can still finish on fewer workers or
+                # degrade to local
+                self._count("spawn_failures")
+                self.phase.record_failure("fleet", e)
+                obs.event("distrib.spawn_failed", worker=i,
+                          error=f"{type(e).__name__}: {e}")
+                continue
+            self._procs[i] = proc
+            self._count("workers_spawned")
+            obs.event("distrib.spawn", worker=i, pid=proc.pid)
+
+    # -- connection handling ------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return   # socket closed during shutdown
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 name="distrib-conn", daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        worker = -1
+        try:
+            f = conn.makefile("rwb")
+            while True:
+                try:
+                    req = read_message(f)
+                    if req is None:
+                        break
+                    if "worker" in req:
+                        worker = int(req["worker"])
+                    resp = self._dispatch(req)
+                except (ValueError, KeyError, TypeError) as e:
+                    resp = {"ok": False, "error": f"{e}"}
+                except Exception as e:  # noqa: BLE001 — one bad request
+                    # must not take down the coordinator
+                    resp = {"ok": False,
+                            "error": f"{type(e).__name__}: {e}"}
+                write_message(f, resp)
+        except (OSError, BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            # EOF on any of a worker's connections is the fast death
+            # signal: a SIGKILLed worker's kernel-closed sockets get its
+            # leases expired right now, not a TTL from now
+            if worker >= 0:
+                self._worker_dead(worker, "connection lost")
+
+    def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "hello":
+            return {"ok": True, "lease_ttl": self.lease_ttl,
+                    "heartbeat": distrib_heartbeat(self.lease_ttl)}
+        if op == "fetch":
+            return self._fetch(int(req["worker"]))
+        if op == "heartbeat":
+            return self._heartbeat(int(req["worker"]), int(req["chunk"]),
+                                   int(req["attempt"]))
+        if op == "result":
+            return self._result(req)
+        if op == "error":
+            return self._chunk_error(req)
+        raise ValueError(f"unknown op {op!r}")
+
+    # -- assignment ---------------------------------------------------------
+
+    def _fetch(self, worker: int) -> dict:
+        with self._cv:
+            if self._stopping or all(c.state == "done"
+                                     for c in self.chunks):
+                return {"ok": True, "drain": True}
+            now = time.monotonic()
+            eligible = [c for c in self.chunks
+                        if c.state == "pending" and not c.local
+                        and c.next_eligible <= now]
+            if eligible:
+                # prefer a chunk this worker has not attempted (the
+                # "retry on a different worker" rule), then chunk order
+                chunk = min(eligible,
+                            key=lambda c: (worker in c.tried, c.index))
+                return self._assign(chunk, worker, speculative=False)
+            chunk = self._straggler(worker, now)
+            if chunk is not None:
+                return self._assign(chunk, worker, speculative=True)
+            return {"ok": True, "wait": True, "poll_s": 0.2}
+
+    def _straggler(self, worker: int, now: float) -> Optional[Chunk]:
+        """The longest-running chunk past the speculation threshold that
+        `worker` could duplicate (call with the lock held)."""
+        factor = distrib_speculate()
+        if factor <= 0 or not self.completed_walls:
+            return None
+        median = statistics.median(self.completed_walls)
+        best, best_elapsed = None, 0.0
+        for c in self.chunks:
+            if (c.state != "running" or c.local or worker in c.tried
+                    or len(c.leases) >= 2 or not c.leases):
+                continue
+            elapsed = now - min(ls.t_start for ls in c.leases.values())
+            if elapsed > factor * median and elapsed > best_elapsed:
+                best, best_elapsed = c, elapsed
+        return best
+
+    def _assign(self, c: Chunk, worker: int, speculative: bool) -> dict:
+        c.attempts += 1
+        attempt = c.attempts
+        c.state = "running"
+        c.tried.add(worker)
+        # journal ownership: the canonical per-chunk journal resumes a
+        # re-dispatch, but only one live writer may ever hold it — a
+        # merely-unresponsive holder keeps it and the new attempt gets a
+        # fresh side journal
+        canonical = not c.journal_held
+        if canonical:
+            c.journal_held = True
+            journal = c.journal
+        else:
+            journal = os.path.join(c.dir, f"journal.a{attempt}.jsonl")
+        c.leases[attempt] = Lease(worker, attempt, self.lease_ttl,
+                                  canonical)
+        self._count("dispatches")
+        if speculative:
+            self._count("speculative")
+        if attempt > 1 and not speculative:
+            self._count("redispatches")
+        obs.event("distrib.dispatch", chunk=c.index, worker=worker,
+                  attempt=attempt, speculative=speculative,
+                  canonical_journal=canonical)
+        return {"ok": True, "chunk": {
+            "index": c.index, "attempt": attempt,
+            "sequences": self.sequences, "overlaps": self.overlaps,
+            "target": c.target, "args": self.args,
+            "include_unpolished": self.include_unpolished,
+            "backend": self.backend, "journal": journal,
+            "output": os.path.join(c.dir, f"out.a{attempt}.fasta"),
+        }}
+
+    # -- worker messages ----------------------------------------------------
+
+    def _heartbeat(self, worker: int, index: int, attempt: int) -> dict:
+        with self._cv:
+            c = self.chunks[index]
+            lease = c.leases.get(attempt)
+            if lease is None or c.state == "done":
+                # the attempt was superseded (lease expired and the
+                # chunk re-dispatched, or another attempt won)
+                return {"ok": True, "cancel": True}
+            lease.deadline = time.monotonic() + self.lease_ttl
+            self._count("heartbeats")
+            return {"ok": True, "cancel": False}
+
+    def _result(self, req: dict) -> dict:
+        index = int(req["chunk"])
+        attempt = int(req["attempt"])
+        stats = req.get("stats") or {}
+        with self._cv:
+            c = self.chunks[index]
+            lease = c.leases.pop(attempt, None)
+            if c.state == "done":
+                # first result won already; this duplicate is discarded
+                # deterministically (its per-attempt output file is
+                # never installed)
+                self._count("duplicates")
+                obs.event("distrib.duplicate", chunk=index,
+                          worker=int(req["worker"]), attempt=attempt)
+                return {"ok": True, "accepted": False}
+            c.state = "done"
+            c.served_by = "fleet"
+            c.output = str(req["output"])
+            c.stats = stats
+            self.phase.record_served("fleet")
+            if lease is not None:
+                wall = time.monotonic() - lease.t_start
+                self.completed_walls.append(wall)
+                self.phase.add_wall("fleet", wall)
+            replayed = int(stats.get("journal_replayed") or 0)
+            if replayed:
+                self._count("journal_replayed", replayed)
+            self._count("chunks_fleet")
+            obs.event("distrib.chunk_done", chunk=index,
+                      worker=int(req["worker"]), attempt=attempt,
+                      replayed=replayed)
+            self._cv.notify_all()
+            return {"ok": True, "accepted": True}
+
+    def _chunk_error(self, req: dict) -> dict:
+        index = int(req["chunk"])
+        attempt = int(req["attempt"])
+        err = str(req.get("error", "worker error"))
+        with self._cv:
+            c = self.chunks[index]
+            lease = c.leases.pop(attempt, None)
+            if lease is not None and lease.canonical:
+                # the worker survived to report, so its journal writer
+                # is closed: the canonical journal is safe to hand on
+                c.journal_held = False
+            if c.state != "done":
+                self._fail_chunk(c, RuntimeError(err))
+            obs.event("distrib.chunk_error", chunk=index,
+                      worker=int(req["worker"]), attempt=attempt,
+                      error=err)
+            return {"ok": True}
+
+    # -- failure paths (call with the lock held) ----------------------------
+
+    def _fail_chunk(self, c: Chunk, exc: BaseException) -> None:
+        c.failures += 1
+        self.phase.record_failure("fleet", exc)
+        self.phase.retries += 1
+        if not c.leases and c.state != "done":
+            c.state = "pending"
+            backoff = distrib_retry_base() * (2 ** (c.failures - 1))
+            c.next_eligible = time.monotonic() + backoff
+            self._cv.notify_all()
+
+    def _worker_dead(self, worker: int, why: str) -> None:
+        with self._cv:
+            if worker in self._dead_workers:
+                return
+            if self._stopping or all(c.state == "done"
+                                     for c in self.chunks):
+                return   # clean drain-and-exit, not a death
+            self._dead_workers.add(worker)
+            self._count("workers_dead")
+            obs.event("distrib.worker_dead", worker=worker, cause=why)
+            for c in self.chunks:
+                held = [a for a, ls in c.leases.items()
+                        if ls.worker == worker]
+                for a in held:
+                    lease = c.leases.pop(a)
+                    if lease.canonical:
+                        # the writer is dead: release the canonical
+                        # journal so the re-dispatch resumes it
+                        c.journal_held = False
+                    self._count("lease_expired")
+                if held and c.state != "done":
+                    self._fail_chunk(
+                        c, RuntimeError(f"worker {worker} died ({why}) "
+                                        f"holding chunk {c.index}"))
+
+    def _expire_leases(self) -> None:
+        now = time.monotonic()
+        with self._cv:
+            for c in self.chunks:
+                expired = [a for a, ls in c.leases.items()
+                           if ls.deadline < now]
+                for a in expired:
+                    lease = c.leases.pop(a)
+                    # NOT releasing the canonical journal here: an
+                    # unresponsive-but-alive holder may still be writing
+                    self._count("lease_expired")
+                    obs.event("distrib.lease_expired", chunk=c.index,
+                              worker=lease.worker, attempt=a)
+                    if c.state != "done":
+                        self._fail_chunk(
+                            c, TimeoutError(
+                                f"lease on chunk {c.index} expired "
+                                f"(worker {lease.worker}, attempt {a})"))
+
+    # -- fleet -> local degradation -----------------------------------------
+
+    def _live_workers(self) -> int:
+        return sum(1 for i, p in self._procs.items()
+                   if p.poll() is None and i not in self._dead_workers)
+
+    def _degrade(self, cause: str) -> None:
+        """Record the fleet→local lattice step (once per run)."""
+        if not self._degraded:
+            self._degraded = True
+            self.phase.record_degrade("fleet", "local",
+                                      RuntimeError(cause))
+
+    def _run_local(self, c: Chunk) -> None:
+        """Execute one chunk in the coordinator through the host-oracle
+        CLI — the same demotion target as the serve host lane, so the
+        output stays byte-identical.  A free canonical journal (cpu
+        fingerprint only) is resumed; otherwise a fresh local journal."""
+        with self._cv:
+            if c.state == "done":
+                return
+            c.state = "running"
+            resume = (not c.journal_held) and self.backend == "cpu"
+        journal = c.journal if resume else os.path.join(
+            c.dir, "journal.local.jsonl")
+        out_path = os.path.join(c.dir, "out.local.fasta")
+        part = out_path + ".part"
+        a = self.args
+        cmd = [sys.executable, "-m", "racon_tpu.cli",
+               "-w", str(a["window_length"]),
+               "-q", str(a["quality_threshold"]),
+               "-e", str(a["error_threshold"]),
+               "-m", str(a["match"]), "-x", str(a["mismatch"]),
+               "-g", str(a["gap"]), "-t", str(a["num_threads"]),
+               "--resume-journal", journal]
+        if not a["trim"]:
+            cmd.append("--no-trimming")
+        if a["fragment_correction"]:
+            cmd.append("-f")
+        if self.include_unpolished:
+            cmd.append("-u")
+        cmd += [self.sequences, self.overlaps, c.target]
+        env = dict(os.environ)
+        for k in _SCOPED_KNOBS:
+            env.pop(k, None)
+        t0 = time.monotonic()
+        with open(part, "w") as out_f, \
+                open(os.path.join(c.dir, "local.stderr.log"), "w") as err_f:
+            rc = subprocess.call(cmd, stdout=out_f, stderr=err_f, env=env)
+        with self._cv:
+            if c.state == "done":
+                self._count("duplicates")   # a late fleet result won
+                return
+            if rc != 0:
+                # the local rung is the floor: a failure here fails the
+                # run (reported by run())
+                c.state = "pending"
+                c.local = True
+                self.phase.record_failure(
+                    "local", RuntimeError(f"local chunk {c.index} "
+                                          f"exited {rc}"))
+                raise RuntimeError(
+                    f"chunk {c.index} failed on the local rung "
+                    f"(exit {rc}; see {c.dir}/local.stderr.log)")
+            os.replace(part, out_path)
+            c.state = "done"
+            c.served_by = "local"
+            c.output = out_path
+            self.phase.record_served("local")
+            self.phase.add_wall("local", time.monotonic() - t0)
+            self._count("chunks_local")
+            obs.event("distrib.chunk_local", chunk=c.index)
+            self._cv.notify_all()
+
+    # -- main loop ----------------------------------------------------------
+
+    def run(self, output_path: str,
+            timeout: Optional[float] = None) -> dict:
+        obs.reset()
+        obs.configure(trace_path=self.trace_path)
+        faults.reset()
+        os.makedirs(self.workdir, exist_ok=True)
+        deadline = (None if not timeout
+                    else time.monotonic() + timeout)
+        with obs.span("distrib.run", workers=self.n_workers,
+                      backend=self.backend):
+            self._layout()
+            self._listen()
+            self._spawn_fleet()
+            try:
+                self._monitor(deadline)
+            finally:
+                self._shutdown_fleet()
+            self._gather(output_path)
+        self.report.finalize()
+        self.phase.extra.update(self.counters)
+        if self.report_path:
+            self.report.write(self.report_path)
+        self.report.write_env()
+        obs.write_trace()
+        replayed = self.counters.get("journal_replayed", 0)
+        return {
+            "output": output_path,
+            "chunks": len(self.chunks),
+            "workers": self.n_workers,
+            "served": dict(self.phase.served),
+            "degradations": list(self.phase.degradations),
+            "counters": dict(self.counters),
+            "journal_replayed": replayed,
+            "report": self.report_path,
+            "trace": self.trace_path,
+            "summary": self.report.summary(),
+        }
+
+    def _monitor(self, deadline: Optional[float]) -> None:
+        while True:
+            with self._cv:
+                if all(c.state == "done" for c in self.chunks):
+                    return
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"distrib run exceeded its deadline with "
+                    f"{sum(1 for c in self.chunks if c.state != 'done')} "
+                    f"chunk(s) unfinished")
+            # reap dead worker processes (second death signal, for a
+            # worker that died before ever connecting)
+            for i, p in list(self._procs.items()):
+                if p.poll() is not None and i not in self._dead_workers:
+                    self._worker_dead(i, f"exited {p.returncode}")
+            self._expire_leases()
+            local_work = []
+            with self._cv:
+                live = self._live_workers()
+                undone = [c for c in self.chunks if c.state != "done"]
+                for c in undone:
+                    if (c.failures > self.max_retries and not c.leases
+                            and c.state == "pending" and not c.local):
+                        c.local = True
+                        self._degrade(f"chunk {c.index} exhausted its "
+                                      f"retry budget ({c.failures} "
+                                      f"failures > {self.max_retries})")
+                if live == 0 and undone:
+                    # fleet collapse: every remaining chunk falls to the
+                    # local rung (leases of dead workers are already
+                    # expired by _worker_dead)
+                    for c in undone:
+                        if c.state == "pending" and not c.local:
+                            c.local = True
+                    if any(c.local for c in undone):
+                        self._degrade("fleet collapse: no live workers")
+                local_work = [c for c in self.chunks
+                              if c.local and c.state == "pending"]
+            for c in local_work:
+                self._run_local(c)
+            with self._cv:
+                self._cv.wait(0.05)
+
+    def _shutdown_fleet(self) -> None:
+        with self._cv:
+            self._stopping = True
+        t0 = time.monotonic()
+        for p in self._procs.values():
+            while p.poll() is None and time.monotonic() - t0 < 5.0:
+                time.sleep(0.05)
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def _gather(self, output_path: str) -> None:
+        """Ordered gather: chunk outputs concatenate in chunk order, so
+        the result is byte-identical to an unchunked run."""
+        part = output_path + ".part"
+        with open(part, "wb") as out:
+            for c in self.chunks:
+                assert c.state == "done" and c.output, c.index
+                with open(c.output, "rb") as f:
+                    out.write(f.read())
+        os.replace(part, output_path)

@@ -1,0 +1,167 @@
+"""One chunk-worker of the `racon-tpu distrib` fleet.
+
+A worker is a client of the coordinator (coordinator.py): it opens two
+connections — commands and heartbeats — says ``hello``, then loops
+``fetch`` → polish → ``result`` until told to ``drain``.  Each fetched
+chunk runs through the normal ``create_polisher`` seam with the
+coordinator-assigned journal armed for resume, so a chunk re-dispatched
+after a crash replays its predecessor's journaled prefix instead of
+recomputing (the ``journal_replayed`` count rides back in the result
+stats as the proof).  While a chunk is in flight a daemon thread renews
+its lease on the heartbeat connection every interval the coordinator
+advertised in the ``hello`` response.
+
+Fault points (resilience/faults.py): ``worker.heartbeat`` fires before
+every renewal — ``raise`` silently stops renewing (the heartbeat-loss /
+straggler path: the lease expires while the polish keeps running),
+``kill=1`` SIGKILLs the worker mid-chunk.  ``worker.result`` fires after
+the polish is journaled and written but before delivery — ``kill=1``
+there is the chaos suite's canonical crash: the re-dispatched chunk
+resumes everything from the journal.  The coordinator scopes
+``RACON_TPU_FAULT`` to one worker index (RACON_TPU_DISTRIB_FAULT_WORKER)
+so a chaos run kills a known worker, not the fleet.
+
+Workers stay resident across chunks: kernel caches (and, on a TPU
+backend, compiled geometries) are paid once per worker, not per chunk —
+the same hot-kernel economics as `racon-tpu serve`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import threading
+import time
+
+from .common import WireError, rpc
+
+
+def _polish_chunk(a: dict) -> dict:
+    """Run one assigned chunk; returns the result stats."""
+    from ..polisher import create_polisher
+
+    t0 = time.monotonic()
+    polisher = create_polisher(
+        a["sequences"], a["overlaps"], a["target"],
+        backend=a.get("backend") or "cpu",
+        journal_path=a["journal"], resume_journal=True,
+        trace_path=None, **(a.get("args") or {}))
+    polisher.initialize()
+    out = polisher.polish(not a.get("include_unpolished"))
+    part = a["output"] + ".part"
+    with open(part, "w") as f:
+        for name, data in out:
+            f.write(f">{name}\n{data}\n")
+    os.replace(part, a["output"])
+    replayed = sum(rep.served.get("journal", 0)
+                   for rep in polisher.report.phases.values())
+    return {
+        "wall_s": round(time.monotonic() - t0, 4),
+        "records": len(out),
+        "polished_bp": sum(len(data) for _, data in out),
+        "journal_replayed": replayed,
+    }
+
+
+def _heartbeat_loop(hb_f, worker: int, index: int, attempt: int,
+                    interval: float, stop: threading.Event) -> None:
+    """Renew the chunk lease until told to stop.  Any failure —
+    injected (worker.heartbeat) or real — silently ends renewal: the
+    coordinator's lease TTL turns heartbeat loss into re-dispatch."""
+    from ..resilience import faults
+
+    while not stop.wait(interval):
+        try:
+            faults.check("worker.heartbeat")
+            resp = rpc(hb_f, {"op": "heartbeat", "worker": worker,
+                              "chunk": index, "attempt": attempt})
+        except Exception:  # noqa: BLE001 — heartbeat loss is a modeled
+            # failure mode, not a crash: the lease expires and the
+            # coordinator re-dispatches
+            return
+        if resp.get("cancel"):
+            return   # superseded; no point renewing a dead lease
+
+
+def run_worker(port: int, worker: int, poll_s: float = 0.2) -> int:
+    from ..resilience import faults
+
+    main_sock = socket.create_connection(("127.0.0.1", port), timeout=600)
+    hb_sock = socket.create_connection(("127.0.0.1", port), timeout=600)
+    main_f = main_sock.makefile("rwb")
+    hb_f = hb_sock.makefile("rwb")
+    hello = rpc(main_f, {"op": "hello", "worker": worker})
+    interval = float(hello.get("heartbeat") or 1.0)
+
+    chunks_done = 0
+    while True:
+        resp = rpc(main_f, {"op": "fetch", "worker": worker})
+        if resp.get("drain"):
+            break
+        if resp.get("wait"):
+            time.sleep(float(resp.get("poll_s") or poll_s))
+            continue
+        a = resp["chunk"]
+        stop = threading.Event()
+        hb = threading.Thread(
+            target=_heartbeat_loop,
+            args=(hb_f, worker, a["index"], a["attempt"], interval, stop),
+            name="distrib-heartbeat", daemon=True)
+        hb.start()
+        try:
+            stats = _polish_chunk(a)
+        except Exception as e:  # noqa: BLE001 — a failed chunk is
+            # reported and the worker lives on to fetch the next one
+            stop.set()
+            hb.join()
+            rpc(main_f, {"op": "error", "worker": worker,
+                         "chunk": a["index"], "attempt": a["attempt"],
+                         "error": f"{type(e).__name__}: {e}"})
+            continue
+        stop.set()
+        hb.join()
+        # the chaos seam: the chunk is fully journaled and its output
+        # written, but the result is not yet delivered — kill=1 here is
+        # the canonical mid-chunk crash the resume path must absorb
+        faults.check("worker.result")
+        rpc(main_f, {"op": "result", "worker": worker,
+                     "chunk": a["index"], "attempt": a["attempt"],
+                     "output": a["output"], "stats": stats})
+        chunks_done += 1
+    for f, s in ((main_f, main_sock), (hb_f, hb_sock)):
+        try:
+            f.close()
+            s.close()
+        except OSError:
+            pass
+    return chunks_done
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="racon-tpu distrib worker",
+        description="one chunk-worker process of a racon-tpu distrib "
+                    "fleet (spawned by the coordinator; not normally "
+                    "run by hand)")
+    p.add_argument("--port", type=int, required=True,
+                   help="coordinator TCP port on 127.0.0.1")
+    p.add_argument("--worker", type=int, required=True,
+                   help="this worker's index in the fleet")
+    args = p.parse_args(argv)
+    try:
+        done = run_worker(args.port, args.worker)
+    except WireError as e:
+        # coordinator went away: exit quietly — the run is over (or the
+        # coordinator crashed, which its own caller reports)
+        print(f"[racon_tpu::distrib] worker {args.worker}: {e}",
+              file=sys.stderr)
+        return 1
+    print(f"[racon_tpu::distrib] worker {args.worker} drained after "
+          f"{done} chunk(s)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,7 +48,14 @@ def _split_fasta(target_path: str, n_chunks_hint: int, outdir: str):
     roughly base-balanced chunk files (record text copied verbatim, so
     each chunk parses to byte-identical contigs).  Returns the chunk
     paths, or None when the target is not splittable (single contig,
-    non-FASTA content) — the caller falls back to sequential phases."""
+    non-FASTA content) — the caller falls back to sequential phases.
+
+    Two consumers depend on the contiguous/verbatim contract: the phase
+    pipeline (below) overlaps alignment and consensus across chunks in
+    one process, and the distrib coordinator (racon_tpu/distrib) farms
+    chunks out to a worker fleet — both re-concatenate per-chunk output
+    in chunk order and rely on it being byte-identical to the unchunked
+    run."""
     import gzip
     import os
 

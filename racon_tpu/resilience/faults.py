@@ -78,6 +78,16 @@ KNOWN_POINTS = frozenset({
                          # consensus buffer (polish output untouched)
     "sanitize.stats",    # sanitizer: one real cross-thread stats-dict
                          # mutation through the guard
+    # distributed seams (racon_tpu/distrib): the coordinator checks
+    # worker.spawn before launching each fleet process; a worker checks
+    # worker.heartbeat before every lease renewal and worker.result
+    # before delivering a finished chunk.  kill=1 on the worker points is
+    # a real SIGKILL of that worker mid-chunk — the chaos suite's
+    # deterministic worker loss.  Scope the env to one worker with
+    # RACON_TPU_DISTRIB_FAULT_WORKER.
+    "worker.spawn",      # coordinator, per worker process launched
+    "worker.heartbeat",  # worker, before each heartbeat send
+    "worker.result",     # worker, before delivering a chunk result
 })
 
 

@@ -8,6 +8,8 @@ import os
 import socket
 from typing import Optional
 
+from .protocol import read_message, write_message
+
 
 class ServeError(RuntimeError):
     """The daemon answered ``ok: false``; the message is its error."""
@@ -34,12 +36,13 @@ class ServeClient:
     def rpc(self, **req) -> dict:
         """One request/response exchange; raises ServeError on
         ``ok: false`` (the raw response rides on the exception)."""
-        self._f.write(json.dumps(req).encode() + b"\n")
-        self._f.flush()
-        line = self._f.readline()
-        if not line:
+        write_message(self._f, req)
+        try:
+            resp = read_message(self._f)
+        except (ValueError, json.JSONDecodeError) as e:
+            raise ServeError(f"malformed daemon response: {e}") from None
+        if resp is None:
             raise ServeError("daemon closed the connection")
-        resp = json.loads(line)
         if not resp.get("ok"):
             err = ServeError(resp.get("error", "request failed"))
             err.response = resp

@@ -1,0 +1,45 @@
+"""Newline-JSON wire helpers shared by every localhost TCP surface.
+
+One JSON object per line in each direction — the `racon-tpu serve`
+daemon (server.py), its client (client.py), and the `racon-tpu distrib`
+coordinator/worker pair (racon_tpu/distrib) all speak the same framing,
+so the guards live in one place:
+
+* ``MAX_LINE`` bounds a single message (a line that long without a
+  terminating newline is an oversized/garbage frame, not a request);
+* ``read_message`` returns the parsed dict, ``None`` on a clean EOF, and
+  raises ``ValueError`` on malformed JSON, a non-object payload, or an
+  oversized frame — the caller decides whether that kills the
+  connection (client) or just the request (server);
+* ``write_message`` frames and flushes one object.
+
+Only the stdlib is imported; the helpers operate on any buffered binary
+file object (``socket.makefile("rwb")``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+#: Protocol guard: one message line must fit comfortably in memory.
+MAX_LINE = 1 << 20
+
+
+def read_message(f) -> Optional[dict]:
+    """Read one newline-framed JSON object.  None = clean EOF."""
+    line = f.readline(MAX_LINE)
+    if not line:
+        return None
+    if len(line) >= MAX_LINE and not line.endswith(b"\n"):
+        raise ValueError(f"message exceeds MAX_LINE ({MAX_LINE} bytes)")
+    msg = json.loads(line)
+    if not isinstance(msg, dict):
+        raise ValueError("message must be a JSON object")
+    return msg
+
+
+def write_message(f, msg: dict) -> None:
+    """Frame and flush one object (the flush is the send)."""
+    f.write(json.dumps(msg).encode() + b"\n")
+    f.flush()

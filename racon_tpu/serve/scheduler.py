@@ -167,24 +167,47 @@ class Scheduler:
         """Re-queue every job directory holding a spec.json without a
         result.json — the unfinished work of a previous daemon life.  A
         spec that no longer admits (inputs deleted, invalid) is marked
-        failed so it cannot retry forever on every restart."""
+        failed so it cannot retry forever on every restart.
+
+        Torn files never crash the restart path: a result.json a killed
+        daemon left unparseable (or parseable but not an object) is
+        discarded so the job counts as unfinished and re-queues from its
+        spec; a spec.json torn the same way fails that one job with the
+        usual recovery warning.  Either way the daemon comes up — the
+        broad per-job except is the lattice-of-last-resort for whatever
+        shape mid-write truncation produced."""
         jobs_root = os.path.join(self.session.workdir, "jobs")
         recovered = []
         for job_id in sorted(os.listdir(jobs_root) if
                              os.path.isdir(jobs_root) else ()):
             jd = os.path.join(jobs_root, job_id)
             spec_path = os.path.join(jd, "spec.json")
-            if (not os.path.isfile(spec_path)
-                    or os.path.isfile(os.path.join(jd, "result.json"))):
+            if not os.path.isfile(spec_path):
                 continue
+            result_path = os.path.join(jd, "result.json")
+            if os.path.isfile(result_path):
+                if self._result_intact(result_path):
+                    continue
+                try:
+                    os.remove(result_path)   # truncate-and-requeue
+                except OSError:
+                    continue   # unreadable AND undeletable: leave it
+                print(f"[racon_tpu::serve] WARNING: discarding torn "
+                      f"result.json for job {job_id}; re-queueing",
+                      file=sys.stderr)
             try:
                 with open(spec_path) as f:
-                    spec = JobSpec.from_dict(json.load(f))
+                    doc = json.load(f)
+                if not isinstance(doc, dict):
+                    raise ValueError(f"spec.json holds "
+                                     f"{type(doc).__name__}, not an object")
+                spec = JobSpec.from_dict(doc)
                 spec.job_id = job_id
                 self.submit(spec)
                 recovered.append(job_id)
-            except (AdmissionError, ValueError, OSError,
-                    json.JSONDecodeError) as e:
+            except Exception as e:  # noqa: BLE001 — a torn spec.json can
+                # decode to anything; one damaged job directory must not
+                # take down the restart path
                 job = Job(JobSpec("", "", "", job_id=job_id), job_id)
                 job.state = "failed"
                 job.error = f"recovery failed: {type(e).__name__}: {e}"
@@ -195,6 +218,16 @@ class Scheduler:
                 print(f"[racon_tpu::serve] WARNING: cannot recover job "
                       f"{job_id}: {e}", file=sys.stderr)
         return recovered
+
+    @staticmethod
+    def _result_intact(path: str) -> bool:
+        """Whether a result.json parses to an object — anything else is
+        the torn tail of a write the dying daemon never finished."""
+        try:
+            with open(path) as f:
+                return isinstance(json.load(f), dict)
+        except (OSError, ValueError, json.JSONDecodeError):
+            return False
 
     # -- submission / queries ----------------------------------------------
 
@@ -474,9 +507,13 @@ class Scheduler:
         jd = self.session.job_dir(job.id)
         try:
             os.makedirs(jd, exist_ok=True)
-            with open(os.path.join(jd, "spec.json"), "w") as f:
+            # tmp + rename, like _persist_result: a daemon killed
+            # mid-write must never leave a torn spec.json for recover()
+            tmp = os.path.join(jd, "spec.json.tmp")
+            with open(tmp, "w") as f:
                 json.dump(job.spec.as_dict(), f, indent=1)
                 f.write("\n")
+            os.replace(tmp, os.path.join(jd, "spec.json"))
         except OSError as e:
             print(f"[racon_tpu::serve] WARNING: cannot persist spec for "
                   f"{job.id}: {e}", file=sys.stderr)

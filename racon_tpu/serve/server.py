@@ -34,11 +34,11 @@ import sys
 import threading
 from typing import Optional
 
+from .protocol import MAX_LINE, read_message, write_message  # noqa: F401
+# (MAX_LINE is re-exported: it is this daemon's documented protocol
+# bound and pre-protocol.py importers reference it from here)
 from .scheduler import AdmissionError, Scheduler
 from .session import JobSpec, PolishSession, serve_port
-
-#: Protocol guard: one request line must fit comfortably in memory.
-MAX_LINE = 1 << 20
 
 
 class ServeDaemon:
@@ -135,13 +135,10 @@ class ServeDaemon:
         try:
             f = conn.makefile("rwb")
             while True:
-                line = f.readline(MAX_LINE)
-                if not line:
-                    return
                 try:
-                    req = json.loads(line)
-                    if not isinstance(req, dict):
-                        raise ValueError("request must be a JSON object")
+                    req = read_message(f)
+                    if req is None:
+                        return
                     resp = self._dispatch(req)
                 except AdmissionError as e:
                     resp = {"ok": False, "error": str(e),
@@ -153,8 +150,7 @@ class ServeDaemon:
                     # must not take down the connection (or the daemon)
                     resp = {"ok": False,
                             "error": f"{type(e).__name__}: {e}"}
-                f.write(json.dumps(resp).encode() + b"\n")
-                f.flush()
+                write_message(f, resp)
                 if resp.get("bye"):
                     self.stop(wait=False)
                     return

@@ -15,6 +15,14 @@ def test_dryrun_multichip_8():
     __graft_entry__.dryrun_multichip(8)
 
 
+def test_dryrun_distrib_two_process_byte_identity():
+    """The distrib rung of the multichip gate standalone: a 2-process
+    localhost fleet must gather byte-identically to the oracle."""
+    import __graft_entry__
+    note = __graft_entry__.dryrun_distrib(2)
+    assert "byte-identical" in note
+
+
 def test_entry_compiles():
     import __graft_entry__
     fn, args = __graft_entry__.entry()

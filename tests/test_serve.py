@@ -324,6 +324,60 @@ def test_scheduler_device_failure_demotes_to_host_lane(tmp_path):
         sched.shutdown(wait=True, timeout=10)
 
 
+def test_recover_tolerates_torn_spec_and_result(tmp_path):
+    """Restart-path regression: a daemon SIGKILLed mid-write can leave
+    spec.json or result.json torn in arbitrary ways.  recover() must
+    (a) discard a torn result.json and re-queue the job from its good
+    spec, (b) mark a job with an unparseable or non-object spec failed
+    instead of crashing the restart, and (c) leave finished jobs with
+    intact results alone."""
+    paths = _write_dataset(tmp_path)
+    ses = _FakeSession(tmp_path / "state")
+    jobs_root = os.path.join(ses.workdir, "jobs")
+
+    def _job_dir(job_id):
+        d = os.path.join(jobs_root, job_id)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    # jobA: good spec + result torn mid-write -> unfinished, re-queued
+    a = _job_dir("jobA")
+    with open(os.path.join(a, "spec.json"), "w") as f:
+        json.dump(_spec(paths, job_id="jobA").as_dict(), f)
+    with open(os.path.join(a, "result.json"), "w") as f:
+        f.write('{"job_id": "jobA", "state": "do')
+    # jobB: spec parses but is not an object -> failed, not crashed
+    b = _job_dir("jobB")
+    with open(os.path.join(b, "spec.json"), "w") as f:
+        f.write("null\n")
+    # jobC: spec truncated mid-write -> failed, not crashed
+    c = _job_dir("jobC")
+    with open(os.path.join(c, "spec.json"), "w") as f:
+        f.write('{"seq')
+    # jobD: intact spec + intact result -> finished, left alone
+    d = _job_dir("jobD")
+    with open(os.path.join(d, "spec.json"), "w") as f:
+        json.dump(_spec(paths, job_id="jobD").as_dict(), f)
+    with open(os.path.join(d, "result.json"), "w") as f:
+        json.dump({"job_id": "jobD", "state": "done"}, f)
+
+    sched = Scheduler(ses, queue_depth=8, max_jobs=8, host_lane=False)
+    recovered = sched.recover()              # must not raise
+    assert recovered == ["jobA"]
+    assert not os.path.exists(os.path.join(a, "result.json"))
+    assert sched.get("jobA").state == "queued"
+    for jid in ("jobB", "jobC"):
+        j = sched.get(jid)
+        assert j.state == "failed", j.as_status()
+        assert "recovery failed" in j.error
+        with open(os.path.join(jobs_root, jid, "result.json")) as f:
+            assert json.load(f)["state"] == "failed"
+    with pytest.raises(KeyError):
+        sched.get("jobD")                    # finished: not re-queued
+    with open(os.path.join(d, "result.json")) as f:
+        assert json.load(f)["state"] == "done"
+
+
 # --------------------------------------------------------- daemon protocol
 
 def test_server_e2e_concurrent_jobs_byte_identical(tmp_path, monkeypatch):
