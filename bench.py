@@ -302,6 +302,21 @@ def pack_split(report_summary) -> dict:
     return out
 
 
+def serial_steps_stamp(cm) -> dict:
+    """Top-level predicted per-phase serial DP step totals from the
+    `cost_model` stamp — the one number the column-compression /
+    row-packing work moves, lifted out of the nested stamp so trend
+    readers can diff it across log generations.  None when the run
+    recorded no cost model (metrics disarmed, serve/distrib lanes,
+    pre-cost-model writers)."""
+    if not isinstance(cm, dict):
+        return None
+    out = {ph: row["serial_steps"]
+           for ph, row in cm.get("phases", {}).items()
+           if isinstance(row, dict) and "serial_steps" in row}
+    return out or None
+
+
 def normalize_entry(e: dict) -> dict:
     """Reader-side honesty backfill for bench JSON entries/log lines.
 
@@ -336,6 +351,11 @@ def normalize_entry(e: dict) -> dict:
         # old logs: recover the split from the embedded report when the
         # executor stamped it there, else explicit null ("not measured")
         e = dict(e, pack_split=pack_split(e.get("report")) or None)
+    if "serial_steps" not in e:
+        # old logs: recover per-phase predicted step totals from the
+        # embedded cost-model stamp when it carried them, else explicit
+        # null ("not predicted")
+        e = dict(e, serial_steps=serial_steps_stamp(e.get("cost_model")))
     return e
 
 
@@ -352,11 +372,13 @@ def degraded_result(mbps_cpu: float, note: str = "") -> dict:
         "unit": "Mbp/s",
         "vs_baseline": None,
         "device_status": "unreachable",
-        # no device run: no prediction-vs-measured join and no
-        # pack-vs-kernel wall split — explicit nulls keep
-        # normalize_entry a fixed point on fresh entries
+        # no device run: no prediction-vs-measured join, no
+        # pack-vs-kernel wall split, no serial-step prediction —
+        # explicit nulls keep normalize_entry a fixed point on fresh
+        # entries
         "cost_model": None,
         "pack_split": None,
+        "serial_steps": None,
     }
 
 
@@ -543,6 +565,7 @@ def main():
         "report": rep_tpu, "phase_wall": phase_wall(rep_tpu),
         "pack_split": pack_split(rep_tpu) or None,
         "cost_model": cm,
+        "serial_steps": serial_steps_stamp(cm),
         **({"sanitize": True} if sanitized else {}),
     })
     print(json.dumps({
@@ -554,6 +577,7 @@ def main():
         "report": rep_tpu, "phase_wall": phase_wall(rep_tpu),
         "pack_split": pack_split(rep_tpu) or None,
         "cost_model": cm,
+        "serial_steps": serial_steps_stamp(cm),
         **({"sanitize": True} if sanitized else {}),
     }))
     print(f"[bench] tpu: {bp_tpu} bp in {dt_tpu:.1f}s | "
@@ -644,6 +668,7 @@ def serve_profile(jobs: int = 4, clients: int = 2) -> int:
         "vs_baseline": None,
         "cost_model": None,
         "pack_split": None,
+        "serial_steps": None,
         "serve": serve_stats,
         **({"device_status": "unreachable"} if degraded else {}),
     }
@@ -654,6 +679,7 @@ def serve_profile(jobs: int = 4, clients: int = 2) -> int:
         "value": round(value, 4), "vs_baseline": None,
         "kernel": config.get_str("RACON_TPU_POA_KERNEL") or "ls",
         "serve": serve_stats, "cost_model": None, "pack_split": None,
+        "serial_steps": None,
         **({"device_status": "unreachable"} if degraded else {}),
     })
     print(json.dumps(entry))
@@ -721,6 +747,7 @@ def distrib_profile(workers: int = 3) -> int:
         "vs_baseline": None,
         "cost_model": None,
         "pack_split": None,
+        "serial_steps": None,
         "distrib": distrib_stats,
     }
     assert normalize_entry(dict(entry)) == entry, \
@@ -729,7 +756,7 @@ def distrib_profile(workers: int = 3) -> int:
         "mbp": MBP, "input": INPUT, "profile": f"distrib-{PROFILE}",
         "value": round(value, 4), "vs_baseline": None,
         "kernel": "host", "distrib": distrib_stats,
-        "cost_model": None, "pack_split": None,
+        "cost_model": None, "pack_split": None, "serial_steps": None,
     })
     print(json.dumps(entry))
     served_total = sum(result["served"].values())

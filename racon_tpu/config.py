@@ -56,6 +56,14 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
        "window/program)"),
     _k("RACON_TPU_DEVICE_ALIGNER", "auto", "str",
        "phase-1 aligner: auto | hirschberg | 1/xla | 0/host"),
+    _k("RACON_TPU_POA_COLSTEP", "1", "bool",
+       "column-compressed POA DP stepping: same-column siblings (v2) / "
+       "rank pairs (ls) share one serial loop iteration (0 restores the "
+       "one-rank-per-step loop; output is byte-identical either way)"),
+    _k("RACON_TPU_ALIGN_PACK", "1", "bool",
+       "packed Hirschberg DP: 4 query bases per word, 4 DP rows per "
+       "serial loop iteration (0 restores one-row-per-step kernels; "
+       "output is byte-identical either way)"),
     _k("RACON_TPU_BATCH_WINDOWS", None, "int",
        "windows per device batch (default: 64 on TPU, 4 elsewhere)"),
     _k("RACON_TPU_PIPELINE_DEPTH", "2", "int",

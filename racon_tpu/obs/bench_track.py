@@ -63,6 +63,13 @@ def _normalize(e: dict) -> dict:
                 e["vs_baseline"] = None
         if "cost_model" not in e:
             e = dict(e, cost_model=None)
+        if "serial_steps" not in e:
+            cm = e.get("cost_model")
+            ss = ({ph: row["serial_steps"]
+                   for ph, row in cm.get("phases", {}).items()
+                   if isinstance(row, dict) and "serial_steps" in row}
+                  if isinstance(cm, dict) else None)
+            e = dict(e, serial_steps=ss or None)
         return e
 
 

@@ -24,7 +24,10 @@ Three layers:
   serial-step term); whichever term wins classifies the bucket as
   compute-bound / bandwidth-bound / serial-step-bound.  The measured
   0.188x story is the serial-step term winning by ~40x, which is why
-  ROADMAP's next cut is rank-loop steps, not FLOPs.
+  the serial-step cut landed: the Pallas POA tiers now divide their
+  step count by POA_COLSTEP_PACK (column-compressed rank pairing,
+  ops/colstep.py) and the packed Hirschberg kernels divide theirs by
+  ALIGN_ROW_PACK (ops/encoding.PACK rows per iteration).
 
 Everything here is stdlib-only (the obs package contract): the kernel
 grid constants are mirrored from ``racon_tpu.ops`` and pinned equal by
@@ -57,6 +60,20 @@ POA_TIERS = ("ls", "v2", "xla")
 #: as divergent layer bases fork nodes.  λ at ~30x measured ~2x
 #: (docs/benchmarks.md: ~1000 ranks over a 500-base backbone).
 NODE_GROWTH = 2.0
+
+#: ops.colstep.PACK — ranks retired per serial iteration by the
+#: column-compressed Pallas loops (v2 pairs adjacent same-column
+#: siblings, ls retires unconditional rank pairs).  At NODE_GROWTH=2.0
+#: the average column multiplicity is 2, so the greedy pairer runs at
+#: its ceiling and the serial-step divisor is the full pack factor.
+#: Applies to the v2 and ls tiers only; the XLA twin keeps the
+#: one-rank-per-step scan.
+POA_COLSTEP_PACK = 2.0
+
+#: ops.encoding.PACK — query bases packed per int32 word by the packed
+#: Hirschberg kernels; each serial loop iteration scores PACK adjacent
+#: DP rows, dividing the row-scan trip count.
+ALIGN_ROW_PACK = 4.0
 
 #: Vector ops per DP cell (sub/ins/del merge, weight add, move select,
 #: cummax contribution) — same math in all three tiers.
@@ -200,6 +217,10 @@ def poa_window_cost(depth: int, wl_class: int, tier: str) -> CostEstimate:
     # matrix lives in VMEM (v2 ring / ls ring), so it does not cross HBM.
     hbm = depth * wl_class * POA_LAYER_BYTES + 2 * wl_class * 5
     steps = depth * ranks
+    if tier in ("v2", "ls"):
+        # Column-compressed stepping (ops/colstep.py): the Pallas loops
+        # retire rank pairs per serial iteration.
+        steps /= POA_COLSTEP_PACK
     if tier == "ls":
         # G windows share one program's rank loop: the serial term
         # amortizes per window, the cell work does not.
@@ -217,7 +238,9 @@ def align_job_cost(cap: int, band: int, tier: str = "xla") -> CostEstimate:
     cells = float(cap) * band
     if tier == "hirschberg":
         cells *= 2.0
-        steps = 4.0 * cap          # row scans across recursion levels
+        # Row scans across recursion levels; the packed kernels score
+        # ALIGN_ROW_PACK adjacent rows per serial iteration.
+        steps = 4.0 * cap / ALIGN_ROW_PACK
         hbm = cap * 2.0            # sequences only; no moves matrix
     else:
         steps = 3.0 * cap          # row scan + traceback chain
@@ -413,10 +436,11 @@ def predict_from_counters(counters: Dict[str, int],
         steps1 = float(raw)                      # sum(depth_i) * C
         ranks_steps = steps1 * NODE_GROWTH       # rank-loop steps
         cells = ranks_steps * c                  # DP cells
+        step_div = {"ls": LS_GROUP * POA_COLSTEP_PACK,
+                    "v2": POA_COLSTEP_PACK}.get(tier, 1.0)
         est = CostEstimate(cells * POA_FLOPS_PER_CELL,
                            steps1 * POA_LAYER_BYTES,
-                           ranks_steps / (LS_GROUP if tier == "ls"
-                                          else 1.0))
+                           ranks_steps / step_div)
         dev_share = 1.0 - host_frac
         dev_est = est.scaled(dev_share)
         sec, verdict = roofline(dev_est, prof)
@@ -450,7 +474,8 @@ def predict_from_counters(counters: Dict[str, int],
     hs_cells = counters.get("align.cells.hirschberg", 0)
     if hs_cells:
         est = CostEstimate(hs_cells * ALIGN_FLOPS_PER_CELL,
-                           hs_cells * 0.1, hs_cells * 4.0 / 256.0)
+                           hs_cells * 0.1,
+                           hs_cells * (4.0 / ALIGN_ROW_PACK) / 256.0)
         a_est = a_est.plus(est)
         dev_cells += float(hs_cells)
         sec, verdict = roofline(est, prof)
@@ -469,8 +494,10 @@ def predict_from_counters(counters: Dict[str, int],
         "buckets": buckets,
         "phases": {
             "poa": {"predicted_s": poa_s, "verdict": poa_verdict,
-                    "tier": tier},
-            "align": {"predicted_s": align_s, "verdict": align_verdict},
+                    "tier": tier,
+                    "serial_steps": poa_est.serial_steps},
+            "align": {"predicted_s": align_s, "verdict": align_verdict,
+                      "serial_steps": a_est.serial_steps},
         },
     }
 
@@ -646,6 +673,7 @@ def bench_cost_model(snapshot: Optional[dict], phase_wall: Dict[str, float],
         p_s = row["predicted_s"]
         entry = {"predicted_s": round(p_s, 4),
                  "measured_s": meas,
+                 "serial_steps": round(row.get("serial_steps", 0.0), 1),
                  "verdict": row["verdict"]}
         if meas and p_s > 0.0:
             entry["error_pct"] = round(_err_pct(p_s, meas), 1)

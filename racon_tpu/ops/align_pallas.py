@@ -42,7 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import config
-from .encoding import encode
+from .encoding import encode, pack_bases
 from .kernel_cache import device_keyed_cache
 
 INF = 1 << 28
@@ -81,9 +81,18 @@ def _shard_over_mesh(build_local, batch, n_in, n_out):
 # distance-only kernels
 # ---------------------------------------------------------------------------
 
+def _pack_factor() -> int:
+    """Row-pack factor for the Hirschberg kernels: PACK (4) query bases
+    per 32-bit word and per serial loop iteration (RACON_TPU_ALIGN_PACK,
+    default on), 1 = the one-row-per-step kernels."""
+    from .encoding import PACK
+
+    return PACK if config.get_bool("RACON_TPU_ALIGN_PACK") else 1
+
+
 @device_keyed_cache(maxsize=64)
 def _build_edge_kernel(rcap: int, K: int, backward: bool,
-                       interpret: bool = False):
+                       interpret: bool = False, pack: int = 1):
     """Batched banded DP over up to `rcap` rows; returns the last row.
 
     Per task (one grid program): query slice q (rcap), target slice t
@@ -91,11 +100,19 @@ def _build_edge_kernel(rcap: int, K: int, backward: bool,
     offset). Lane o of a row holds cell (i, j = i + dmin + o); the
     backward kernel mirrors the recurrence (B[i][o] from B[i+1][o],
     B[i+1][o-1]... expressed with opposite shifts).
+
+    pack > 1: the query arrives packed `pack` codes per int32 word
+    (encoding.pack_bases; REVERSED for the backward kernel so the word
+    index ascends with the loop) and each serial iteration retires
+    `pack` DP rows off one scalar word read — the fori_loop trip count
+    drops from R to ceil(R / pack).  Rows past R carry the row value
+    through unchanged, so the result is byte-identical to pack == 1.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     TCAP = rcap + K
+    QIN = rcap if pack == 1 else max(128, _round_up(rcap // pack, 128))
 
     def kernel(scal_ref, q_ref, t_ref, out_ref, row_scr, tq_scr):
         lane_k = jax.lax.broadcasted_iota(jnp.int32, (1, K), 1)
@@ -134,59 +151,90 @@ def _build_edge_kernel(rcap: int, K: int, backward: bool,
                 k *= 2
             return x
 
+        def fwd_step(i, qc, row):
+            # i = 1..R ; j' = i + dmin + o
+            jv = i + dmin + lane_k
+            # target chars at j'-1 per lane: t[(i-1) + dmin + o],
+            # staged via a dynamic lane rotation of the target row
+            tc = lroll(tq_scr[:], i - 1 + dmin, TCAP)[:, :K]
+            sub = row + jnp.where(tc == qc, 0, 1)
+            up = jnp.where(lane_k < K - 1, pltpu.roll(row, K - 1, 1),
+                           INF) + 1
+            V = jnp.minimum(sub, up)
+            V = jnp.where(jv == 0, i, V)
+            V = jnp.where((jv < 0) | (jv > S), INF, V)
+            gv = lane_k
+            nrow = cummin_fwd(V - gv) + gv
+            nrow = jnp.minimum(nrow, INF)
+            nrow = jnp.where((jv < 0) | (jv > S), INF, nrow)
+            return nrow
+
+        def bwd_step(i, qc, row):
+            jv = i + dmin + lane_k
+            tc = lroll(tq_scr[:], i + dmin, TCAP)[:, :K]  # t[j']
+            # B[i][o]: diag = B[i+1][o] + sub(q[i], t[j']);
+            # down (consume query) = B[i+1][o-1] + 1;
+            # right (consume target) = B[i][o+1] + 1 (suffix chain)
+            sub = row + jnp.where(tc == qc, 0, 1)
+            down = jnp.where(lane_k >= 1, pltpu.roll(row, 1, 1),
+                             INF) + 1
+            V = jnp.minimum(sub, down)
+            V = jnp.where(jv == S, R - i, V)
+            V = jnp.where((jv < 0) | (jv > S), INF, V)
+            gv = K - 1 - lane_k
+            nrow = cummin_bwd(V - gv) + gv
+            nrow = jnp.minimum(nrow, INF)
+            nrow = jnp.where((jv < 0) | (jv > S), INF, nrow)
+            return nrow
+
         if not backward:
             # row 0: F[0][j'] = j' for j' in [0, S]
             j0 = dmin + lane_k
             row = jnp.where((j0 >= 0) & (j0 <= S), j0, INF)
-
-            def body(i, row):
-                # i = 1..R ; j' = i + dmin + o
-                jv = i + dmin + lane_k
-                qc = qchar(i - 1)
-                # target chars at j'-1 per lane: t[(i-1) + dmin + o],
-                # staged via a dynamic lane rotation of the target row
-                tc = lroll(tq_scr[:], i - 1 + dmin, TCAP)[:, :K]
-                sub = row + jnp.where(tc == qc, 0, 1)
-                up = jnp.where(lane_k < K - 1, pltpu.roll(row, K - 1, 1),
-                               INF) + 1
-                V = jnp.minimum(sub, up)
-                V = jnp.where(jv == 0, i, V)
-                V = jnp.where((jv < 0) | (jv > S), INF, V)
-                gv = lane_k
-                nrow = cummin_fwd(V - gv) + gv
-                nrow = jnp.minimum(nrow, INF)
-                nrow = jnp.where((jv < 0) | (jv > S), INF, nrow)
-                return nrow
-
             tq_scr[:] = t_ref[0]
-            row = jax.lax.fori_loop(1, R + 1, body, row)
+            if pack == 1:
+                row = jax.lax.fori_loop(
+                    1, R + 1, lambda i, row: fwd_step(i, qchar(i - 1), row),
+                    row)
+            else:
+                # one packed-word scalar read feeds `pack` rows; rows
+                # past R carry `row` through unchanged (byte-identity)
+                def body(it, row):
+                    qword = lroll(q_ref[0], it, QW)[0, 0]
+                    for p in range(pack):
+                        i = it * pack + 1 + p
+                        qc = (qword >> (8 * p)) & 0xFF
+                        row = jnp.where(i <= R, fwd_step(i, qc, row), row)
+                    return row
+
+                row = jax.lax.fori_loop(0, (R + pack - 1) // pack, body,
+                                        row)
         else:
             # row R: B[R][j'] = S - j'
             jR = R + dmin + lane_k
             row = jnp.where((jR >= 0) & (jR <= S), S - jR, INF)
-
-            def body(k, row):
-                i = R - 1 - k          # i = R-1 .. 0
-                jv = i + dmin + lane_k
-                qc = qchar(i)
-                tc = lroll(tq_scr[:], i + dmin, TCAP)[:, :K]  # t[j']
-                # B[i][o]: diag = B[i+1][o] + sub(q[i], t[j']);
-                # down (consume query) = B[i+1][o-1] + 1;
-                # right (consume target) = B[i][o+1] + 1 (suffix chain)
-                sub = row + jnp.where(tc == qc, 0, 1)
-                down = jnp.where(lane_k >= 1, pltpu.roll(row, 1, 1),
-                                 INF) + 1
-                V = jnp.minimum(sub, down)
-                V = jnp.where(jv == S, R - i, V)
-                V = jnp.where((jv < 0) | (jv > S), INF, V)
-                gv = K - 1 - lane_k
-                nrow = cummin_bwd(V - gv) + gv
-                nrow = jnp.minimum(nrow, INF)
-                nrow = jnp.where((jv < 0) | (jv > S), INF, nrow)
-                return nrow
-
             tq_scr[:] = t_ref[0]
-            row = jax.lax.fori_loop(0, R, body, row)
+            if pack == 1:
+                def body1(k, row):
+                    i = R - 1 - k          # i = R-1 .. 0
+                    return bwd_step(i, qchar(i), row)
+
+                row = jax.lax.fori_loop(0, R, body1, row)
+            else:
+                # the host packed the REVERSED query slice, so word it /
+                # byte p holds q[R - 1 - (it*pack + p)] — the word index
+                # ascends with the serial loop
+                def body(it, row):
+                    qword = lroll(q_ref[0], it, QW)[0, 0]
+                    for p in range(pack):
+                        k = it * pack + p
+                        i = R - 1 - k
+                        qc = (qword >> (8 * p)) & 0xFF
+                        row = jnp.where(k < R, bwd_step(i, qc, row), row)
+                    return row
+
+                row = jax.lax.fori_loop(0, (R + pack - 1) // pack, body,
+                                        row)
 
         out_ref[0] = row
 
@@ -198,7 +246,7 @@ def _build_edge_kernel(rcap: int, K: int, backward: bool,
         return pl.pallas_call(
             kernel,
             grid=(batch,),
-            in_specs=[smem3, vrow(rcap), vrow(TCAP)],
+            in_specs=[smem3, vrow(QIN), vrow(TCAP)],
             out_specs=vrow(K),
             out_shape=jax.ShapeDtypeStruct((batch, 1, K), jnp.int32),
             scratch_shapes=[pltpu.VMEM((1, K), jnp.int32),
@@ -211,7 +259,7 @@ def _build_edge_kernel(rcap: int, K: int, backward: bool,
 
         def fn(scal, q, t):
             out = call(scal.reshape(b, 1, 4),
-                       q.reshape(b, 1, rcap),
+                       q.reshape(b, 1, QIN),
                        t.reshape(b, 1, TCAP))
             return out.reshape(b, K)
 
@@ -230,13 +278,17 @@ def _build_edge_kernel(rcap: int, K: int, backward: bool,
 # ---------------------------------------------------------------------------
 
 @device_keyed_cache(maxsize=32)
-def _build_base_kernel(K: int, interpret: bool = False):
+def _build_base_kernel(K: int, interpret: bool = False, pack: int = 1):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     RB = BASE_ROWS
     TCAP = RB + K
     OPS = _round_up(RB + K + 2, 128)
+    # pack > 1: packed query words (encoding.pack_bases), `pack` DP rows
+    # per serial iteration — same contract as _build_edge_kernel
+    QCAP = _round_up(RB, 128) if pack == 1 else \
+        max(128, _round_up(RB // pack, 128))
 
     def kernel(scal_ref, q_ref, t_ref, ops_ref, cnt_ref, ok_ref,
                MVS, tq_scr):
@@ -262,10 +314,8 @@ def _build_base_kernel(K: int, interpret: bool = False):
         j0 = dmin + lane_k
         row0 = jnp.where((j0 >= 0) & (j0 <= S), j0, INF)
 
-        def body(i, row):
+        def dp_row(i, qc, row):
             jv = i + dmin + lane_k
-            QW = q_ref.shape[-1]
-            qc = pltpu.roll(q_ref[0], jnp.mod(QW - (i - 1), QW), 1)[0, 0]
             tc = pltpu.roll(tq_scr[:], jnp.mod(TCAP - (i - 1 + dmin), TCAP),
                             1)[:, :K]
             sub = row + jnp.where(tc == qc, 0, 1)
@@ -279,10 +329,35 @@ def _build_base_kernel(K: int, interpret: bool = False):
             nrow = cummin_fwd(V - lane_k) + lane_k
             mv = jnp.where(nrow < V, 2, mv)
             nrow = jnp.where((jv < 0) | (jv > S), INF, nrow)
-            MVS[pl.ds(i - 1, 1), :] = mv
-            return nrow
+            return nrow, mv
 
-        jax.lax.fori_loop(1, R + 1, body, row0)
+        QW = q_ref.shape[-1]
+        if pack == 1:
+            def body(i, row):
+                qc = pltpu.roll(q_ref[0], jnp.mod(QW - (i - 1), QW),
+                                1)[0, 0]
+                nrow, mv = dp_row(i, qc, row)
+                MVS[pl.ds(i - 1, 1), :] = mv
+                return nrow
+
+            jax.lax.fori_loop(1, R + 1, body, row0)
+        else:
+            def body(it, row):
+                qword = pltpu.roll(q_ref[0], jnp.mod(QW - it, QW),
+                                   1)[0, 0]
+                for p in range(pack):
+                    i = it * pack + 1 + p
+                    qc = (qword >> (8 * p)) & 0xFF
+                    nrow, mv = dp_row(i, qc, row)
+
+                    @pl.when(i <= R)
+                    def _():
+                        MVS[pl.ds(i - 1, 1), :] = mv
+
+                    row = jnp.where(i <= R, nrow, row)
+                return row
+
+            jax.lax.fori_loop(0, (R + pack - 1) // pack, body, row0)
 
         # traceback from (R, S) to (0, 0); ops: 0=M 1=I(query) 2=D(target)
         def cond(c):
@@ -319,7 +394,7 @@ def _build_base_kernel(K: int, interpret: bool = False):
         return pl.pallas_call(
             kernel,
             grid=(batch,),
-            in_specs=[smem3, vrow(_round_up(RB, 128)), vrow(TCAP)],
+            in_specs=[smem3, vrow(QCAP), vrow(TCAP)],
             out_specs=[vrow(OPS), smem1, smem1],
             out_shape=[
                 jax.ShapeDtypeStruct((batch, 1, OPS), jnp.int32),
@@ -330,8 +405,6 @@ def _build_base_kernel(K: int, interpret: bool = False):
                             pltpu.VMEM((1, TCAP), jnp.int32)],
             interpret=interpret,
         )
-
-    QCAP = _round_up(RB, 128)
 
     def plain(b):
         call = make(b)
@@ -419,11 +492,15 @@ def _pow2(n):
     return b
 
 
-def _task_arrays(pairs, tasks, bands, rcap, K, backward):
+def _task_arrays(pairs, tasks, bands, rcap, K, backward, pack=1):
     """Pack tasks into kernel arrays. The staged target window is clipped
     to the half's band-reachable columns (j <= ib + gdmin + K going
     forward, j >= ia + gdmin going backward) so it fits rcap + K — the
-    full task span can be up to 2*rcap + K."""
+    full task span can be up to 2*rcap + K.
+
+    pack > 1: queries go out as packed words (the backward kernel's
+    query slice reversed first, so its word index ascends with the
+    serial loop)."""
     B = len(tasks)
     TCAP = rcap + K
     scal = np.zeros((B, 4), np.int32)
@@ -442,8 +519,11 @@ def _task_arrays(pairs, tasks, bands, rcap, K, backward):
         S = j_hi - j_lo
         assert 0 <= S <= TCAP, (S, TCAP)
         scal[bi] = (R, S, gdmin + t.ia - j_lo, 0)
-        qs[bi, :R] = q[t.ia:t.ib]
+        qrow = q[t.ia:t.ib]
+        qs[bi, :R] = qrow[::-1] if (pack > 1 and backward) else qrow
         ts[bi, :S] = tt[j_lo:j_hi]
+    if pack > 1:
+        qs = pack_bases(qs, width=max(128, _round_up(rcap // pack, 128)))
     return scal, qs, ts
 
 
@@ -458,17 +538,18 @@ def _split_round(pairs, tasks, bands, failed, interpret):
         rcap = next(rb for rb in ROW_BUCKETS if half <= rb)
         by_bucket.setdefault((rcap, K), []).append(t)
 
+    pk = _pack_factor()
     for (rcap, K), group in sorted(by_bucket.items()):
-        fwd = _build_edge_kernel(rcap, K, False, interpret)
-        bwd = _build_edge_kernel(rcap, K, True, interpret)
+        fwd = _build_edge_kernel(rcap, K, False, interpret, pk)
+        bwd = _build_edge_kernel(rcap, K, True, interpret, pk)
         # forward over [ia, imid], backward over [imid, ib]
         f_tasks, b_tasks = [], []
         for t in group:
             imid = (t.ia + t.ib) // 2
             f_tasks.append(_Task(t.pair, t.ia, imid, t.ja, t.jb))
             b_tasks.append(_Task(t.pair, imid, t.ib, t.ja, t.jb))
-        fs, fq, ft = _task_arrays(pairs, f_tasks, bands, rcap, K, False)
-        bs, bq, bt = _task_arrays(pairs, b_tasks, bands, rcap, K, True)
+        fs, fq, ft = _task_arrays(pairs, f_tasks, bands, rcap, K, False, pk)
+        bs, bq, bt = _task_arrays(pairs, b_tasks, bands, rcap, K, True, pk)
         # pad the batch dim to a power of two so each (rcap, K) bucket
         # compiles a handful of kernel variants, not one per group size
         B = _pow2(len(group))
@@ -506,22 +587,28 @@ def _solve_base(pairs, tasks, bands, segments, failed, interpret):
     for t in tasks:
         K = bands[t.pair][0]
         by_bucket.setdefault(K, []).append(t)
+    pk = _pack_factor()
     for K, group in sorted(by_bucket.items()):
-        kern, OPS, QCAP, TCAP = _build_base_kernel(K, interpret)
+        kern, OPS, QCAP, TCAP = _build_base_kernel(K, interpret, pk)
         for off in range(0, len(group), 64):
             chunk = group[off:off + 64]
             B = _pow2(len(chunk))
             scal = np.zeros((B, 4), np.int32)
-            qs = np.zeros((B, QCAP), np.int32)
+            qraw = np.zeros((B, BASE_ROWS), np.int32)
             ts = np.full((B, TCAP), 255, np.int32)
             for bi, t in enumerate(chunk):
                 q, tt = pairs[t.pair]
                 _, gdmin = bands[t.pair]
                 R, S = t.ib - t.ia, t.jb - t.ja
                 scal[bi] = (R, S, gdmin + t.ia - t.ja, 0)
-                qs[bi, :R] = q[t.ia:t.ib]
+                qraw[bi, :R] = q[t.ia:t.ib]
                 ts[bi, :S] = tt[t.ja:t.jb]
             scal[len(chunk):, 0] = 1  # pad tasks: 1 empty-target row
+            if pk > 1:
+                qs = pack_bases(qraw, width=QCAP)
+            else:
+                # QCAP == _round_up(BASE_ROWS, 128) == BASE_ROWS here
+                qs = qraw
             ops, cnt, ok = (np.asarray(x)
                             for x in kern(B)(scal, qs, ts))
             for bi, t in enumerate(chunk):

@@ -1,0 +1,69 @@
+"""Host-side reference for column-compressed POA stepping.
+
+The device DP loop iterates graph nodes in topological rank order, which
+is exactly column-key order (poa_pallas.py keeps `order` key-sorted
+incrementally; poa_pallas_ls.py's rank space IS key order).  Two facts
+make column compression sound:
+
+* **Equal keys mean same column.**  A node key is either a backbone
+  ordinal or `lo + (hi - lo) / (run + 1)` strictly between its
+  neighbours' keys; two nodes share a key only when the graph update
+  placed them as alternative bases of the same alignment column (the
+  match rule `keys == k0` relies on this exact-equality invariant).
+* **No intra-column edges.**  Every edge goes from a strictly smaller
+  key to a strictly larger key (a predecessor is either the previous
+  matched column or an inserted node keyed strictly below), so nodes of
+  one column never feed each other and their predecessor scans are
+  independent.
+
+The v2 kernel therefore retires a same-column *pair* of adjacent ranks
+per serial loop iteration (greedy adjacent pairing — the in-kernel
+while_loop in poa_pallas.py mirrors `pair_schedule` below), driving the
+trip count to ``n_column_steps(keys) <= n_ranks``.  The lockstep kernel
+cannot pair by column (its 8 lanes hold unrelated windows) and instead
+retires an unconditional rank pair per iteration — `ceil(n / 2)` steps.
+
+This module is the numpy twin the unit tests pin the kernel loop shape
+against; it is also what the cost model's POA_COLSTEP_PACK divisor
+abstracts (obs/costmodel.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+#: Ranks retired per serial iteration when a same-column sibling is
+#: adjacent (v2) or unconditionally (ls).  The kernels are pair-steppers,
+#: not arbitrary-k steppers: a column of m nodes takes ceil(m / 2) steps.
+PACK = 2
+
+
+def pair_schedule(keys) -> List[Tuple[int, int]]:
+    """Greedy adjacent pairing of equal keys in rank order.
+
+    `keys` are the column keys of the live nodes in topological rank
+    order (already key-sorted).  Returns ``[(rank, take), ...]`` with
+    ``take`` in {1, 2}: the exact iteration schedule the v2 kernel's
+    column-compressed while_loop executes over ranks [0, len(keys)).
+    """
+    k = np.asarray(keys)
+    out: List[Tuple[int, int]] = []
+    r, n = 0, len(k)
+    while r < n:
+        take = 2 if (r + 1 < n and k[r + 1] == k[r]) else 1
+        out.append((r, take))
+        r += take
+    return out
+
+
+def n_column_steps(keys) -> int:
+    """Serial DP iterations the column-compressed v2 loop takes."""
+    return len(pair_schedule(keys))
+
+
+def compression(keys) -> float:
+    """Ranks per serial step: len(keys) / n_column_steps (1.0..2.0)."""
+    n = len(np.asarray(keys))
+    return n / n_column_steps(keys) if n else 1.0

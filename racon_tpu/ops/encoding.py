@@ -29,3 +29,39 @@ def encode(ascii_bases: np.ndarray) -> np.ndarray:
 def decode(codes: np.ndarray) -> bytes:
     """uint8/int codes 0..4 -> ASCII bytes."""
     return _DECODE[np.asarray(codes, dtype=np.int64).clip(0, 4)].tobytes()
+
+
+#: Codes packed per int32 word by pack_bases (one byte per code).  A 2-bit
+#: packing (4 codes per BYTE) would be denser but cannot represent code 4
+#: (N / ambiguity) without collapsing it into a real base — which would
+#: break byte-identity against the host on non-ACGT input — so the packed
+#: DP kernels trade density for losslessness: 4 codes per 32-bit word,
+#: one byte each, little-endian byte order.
+PACK = 4
+
+
+def pack_bases(codes: np.ndarray, width: int = 0) -> np.ndarray:
+    """Pack codes 0..4 along the last axis, PACK per int32 word.
+
+    Word w holds codes [PACK*w, PACK*w + PACK); code p sits in byte p
+    (value << 8*p).  The tail word is zero-padded.  `width` pads the
+    packed axis out to a fixed lane count (0 = minimal).  Round-trips
+    exactly through unpack_bases for any values 0..255.
+    """
+    a = np.asarray(codes, dtype=np.int64)
+    n = a.shape[-1]
+    nw = (n + PACK - 1) // PACK
+    w = max(width, nw)
+    padded = np.zeros(a.shape[:-1] + (w * PACK,), dtype=np.int64)
+    padded[..., :n] = a
+    parts = padded.reshape(a.shape[:-1] + (w, PACK))
+    shifts = (np.arange(PACK, dtype=np.int64) * 8)
+    return np.sum(parts << shifts, axis=-1).astype(np.int32)
+
+
+def unpack_bases(words: np.ndarray, n: int) -> np.ndarray:
+    """Inverse of pack_bases: the first n codes along the last axis."""
+    w = np.asarray(words, dtype=np.int64)
+    shifts = (np.arange(PACK, dtype=np.int64) * 8)
+    codes = (w[..., None] >> shifts) & 0xFF
+    return codes.reshape(w.shape[:-1] + (-1,))[..., :n].astype(np.int32)

@@ -614,12 +614,16 @@ def _build_kernel(cfg, B, use_pallas, kind: str = "v2"):
     if not use_pallas:
         kind = "xla"
     faults.check(f"poa.compile.{kind}")
+    # Column-compressed stepping rides in the cache key: flipping the
+    # knob mid-process (hw_session's compressed-vs-flat steps) must not
+    # serve a kernel built under the other loop shape.
+    colstep = config.get_bool("RACON_TPU_POA_COLSTEP")
     # Same build-observability pattern as kernel_cache.device_keyed_cache:
     # a miss is only known after the call, so the span is retroactive.
     misses0 = _build_kernel_cached.cache_info().misses
     t0 = time.monotonic_ns()
     built = _build_kernel_cached(cfg, B, use_pallas, kind, _n_devices(),
-                                 _platform())
+                                 _platform(), colstep)
     if _build_kernel_cached.cache_info().misses != misses0:
         from . import cost_hooks
 
@@ -637,7 +641,8 @@ def _build_kernel(cfg, B, use_pallas, kind: str = "v2"):
 
 
 @functools.lru_cache(maxsize=64)
-def _build_kernel_cached(cfg, B, use_pallas, kind, n_dev, platform):
+def _build_kernel_cached(cfg, B, use_pallas, kind, n_dev, platform,
+                         colstep=True):
     """Single- or multi-device kernel for a B-window batch.
 
     Multi-device: batch dim sharded over the 1-D `windows` mesh — the
@@ -660,10 +665,11 @@ def _build_kernel_cached(cfg, B, use_pallas, kind, n_dev, platform):
             from .poa_pallas import build_pallas_poa_kernel as build
         interp = platform != "tpu"
         if n_dev == 1:
-            return build(cfg, interpret=interp)(B)
+            return build(cfg, interpret=interp, colstep=colstep)(B)
         from ..parallel.mesh import shard_batch_build
         sharded = shard_batch_build(
-            lambda b: build(cfg, interpret=interp)(b), B, 9, 5)
+            lambda b: build(cfg, interpret=interp, colstep=colstep)(b),
+            B, 9, 5)
         assert sharded is not None, (B, n_dev)  # _device_batch divides B
         return sharded
     kernel = poa.build_poa_kernel(cfg)

@@ -34,6 +34,11 @@ Other deliberate choices, none semantic:
     in-subgraph edge marks its source as "has out-edge").
   * the linear-gap cummax runs as lane-prefix + cross-sublane-prefix
     shift-max steps.
+  * the DP rank loop steps per COLUMN, not per node (colstep=True,
+    RACON_TPU_POA_COLSTEP): equal-key nodes are adjacent in rank order
+    with no edges among themselves, so a same-column sibling is processed
+    in the same iteration and the serial trip count is n_column_steps
+    <= n_nodes (ops/colstep.py holds the host-side reference mapping).
 
 VMEM budget (w=500 config: N=1536 -> NW=256, L=768 -> JW=128):
 H and MV (1537, 8, 128) i32 ~6.3 MB each, node/edge state <0.3 MB, staged
@@ -65,7 +70,8 @@ def blocked_width(n: int) -> int:
 
 
 @device_keyed_cache(maxsize=32)
-def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
+def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False,
+                            colstep: bool = True):
     N = cfg.max_nodes
     L = cfg.max_len
     BB = cfg.max_backbone
@@ -299,7 +305,35 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 rmwn(esc, r, loadj(row, Ln))
                 return 0
 
-            jax.lax.fori_loop(r_lo, r_hi, dp_body, 0)
+            if colstep:
+                # Column-compressed stepping: equal-key ("same column")
+                # nodes are adjacent in rank order and have no edges among
+                # themselves (ops/colstep.py documents the invariant), so a
+                # same-column sibling can ride in the same loop iteration —
+                # the trip count drops from n_ranks to n_column_steps.
+                # Both nodes still execute in rank order inside the body,
+                # so the result is byte-identical to the serial loop even
+                # for graphs that violate the invariant (e.g. after an
+                # overflow-failed update): rank r's H row / rank_of / esc
+                # writes land before rank r+1 reads them.
+                def col_cond(c):
+                    return c < r_hi
+
+                def col_body(r):
+                    ku = loadn(key[:], loadn(order[:], r))
+                    dp_body(r, 0)
+                    k2 = loadn(key[:], loadn(order[:], r + 1))
+                    pair = (r + 1 < r_hi) & (k2 == ku)
+
+                    @pl.when(pair)
+                    def _():
+                        dp_body(r + 1, 0)
+
+                    return r + 1 + pair.astype(jnp.int32)
+
+                jax.lax.while_loop(col_cond, col_body, r_lo)
+            else:
+                jax.lax.fori_loop(r_lo, r_hi, dp_body, 0)
 
             # ---- best end node (first max in rank order) ------------------
             escv = esc[:]

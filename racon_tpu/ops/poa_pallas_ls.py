@@ -61,7 +61,8 @@ def _round_up(x, m):
 
 
 @device_keyed_cache(maxsize=32)
-def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False):
+def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
+                              colstep: bool = True):
     N = cfg.max_nodes
     L = cfg.max_len
     BB = cfg.max_backbone
@@ -333,7 +334,32 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False):
                 return 0
 
             rs64 = (r_start // BLK) * BLK
-            jax.lax.fori_loop(rs64, r_end, dp_body, 0)
+            if colstep:
+                # Rank-pair stepping (the lockstep variant of column
+                # compression, RACON_TPU_POA_COLSTEP): the 8 lanes hold
+                # unrelated windows so per-column pairing cannot line up
+                # across the sublane dimension — instead every serial
+                # iteration retires TWO consecutive ranks, halving the
+                # trip count. Ranks still execute strictly in order
+                # inside the body (rank r's ring row is written before
+                # rank r+1's delta scan reads it at d == 1), so the
+                # result is byte-identical to the serial loop. The flush
+                # schedule is untouched: rs64 and BLK are even, so the
+                # (r+1) % BLK == 0 trigger only ever fires on the second
+                # rank of a pair.
+                def pair_body(p, _):
+                    r = rs64 + 2 * p
+                    dp_body(r, 0)
+
+                    @pl.when(r + 1 < r_end)
+                    def _():
+                        dp_body(r + 1, 0)
+
+                    return 0
+
+                jax.lax.fori_loop(0, (r_end - rs64 + 1) // 2, pair_body, 0)
+            else:
+                jax.lax.fori_loop(rs64, r_end, dp_body, 0)
 
             @pl.when(r_end % BLK != 0)
             def _():

@@ -28,12 +28,33 @@ mode, and times each:
   mode 10: mode 9 + a depth-4 delta scan (4 ring-row loads, masked max)
           and 12 exr-style (1,8,128) graph-row loads per rank — the
           ls dp_body's per-rank load traffic
+  mode 11: mode 1 under the COLUMN-COMPRESSED while_loop (the v2
+          colstep path in poa_pallas.py) on synthetic multiplicity-2
+          column keys (key = rank // 2): adjacent same-column ranks
+          retire in one iteration, so the serial trip count halves
+  mode 12: mode 9 under the ls RANK-PAIR loop (poa_pallas_ls.py
+          colstep path): two unconditional dp steps per iteration
+  mode 13: the aligner band-loop baseline — a (1, 128) band row carried
+          in registers, one scalar query-code load (masked loadn) and
+          one shift+select recurrence per DP row
+  mode 14: mode 13 PACKED (align_pallas.py pack path): one packed-word
+          loadn per iteration, 4 byte-extracted rows scored per step —
+          the serial trip count drops to ceil(R / 4)
 
 mode 4 approximates the full v2 dp_body; mode 10 approximates the ls
 dp_body. The deltas between modes say which component to attack next;
 per-node microseconds are printed for each.
 
+Every kernel also returns its MEASURED serial loop-iteration count (a
+carry incremented inside the loop body, read back via a second SMEM
+output) — `--gate` compares the compressed modes against their
+baselines on those measured counts and exits nonzero unless the ratios
+clear the floors (11 vs 1 and 12 vs 9: >= 1.5x; 14 vs 13: >= 2x).
+Interpret-mode safe: the gate measures trip counts, not wall time, so
+CI runs it on CPU.
+
 Usage: python racon_tpu/tools/dp_cost_probe.py [R] [B] [reps]
+       python racon_tpu/tools/dp_cost_probe.py --gate
 """
 
 import os
@@ -50,7 +71,7 @@ from racon_tpu.ops.kernel_cache import device_keyed_cache
 NEG = -(1 << 28)
 
 
-@device_keyed_cache(maxsize=16)
+@device_keyed_cache(maxsize=32)
 def build(mode: int, R: int, B: int, interpret: bool):
     import jax
     import jax.numpy as jnp
@@ -65,8 +86,8 @@ def build(mode: int, R: int, B: int, interpret: bool):
     RING = 128   # lockstep H ring rows (modes 9/10)
     GSLOTS = 16  # lockstep graph-row slots (mode 10 dynamic loads)
 
-    def kernel(seed_ref, out_ref, H, order, base, key, in_cnt, in_src,
-               has_out, gls):
+    def kernel(seed_ref, out_ref, steps_ref, H, order, base, key, in_cnt,
+               in_src, has_out, gls):
         jlane = jax.lax.broadcasted_iota(jnp.int32, (8, JW), 1)
         jsub = jax.lax.broadcasted_iota(jnp.int32, (8, JW), 0)
         jj = jsub * JW + jlane
@@ -156,7 +177,7 @@ def build(mode: int, R: int, B: int, interpret: bool):
             gflat = flane * G
             H[0:1] = (gflat + seed_ref[0, 0, 0]).reshape(1, 1, FW)
 
-            def dp_flat(r, _):
+            def dp_flat(r, c):
                 P = H[pl.ds(r, 1)][0]
                 scvec = jnp.where(flane % 4 == 1, 5, -4)
                 diag = shift1_flat(P, NEG) + scvec
@@ -164,9 +185,9 @@ def build(mode: int, R: int, B: int, interpret: bool):
                 V = jnp.where(diag >= up, diag, up)
                 row = cummax_flat(V - gflat) + gflat
                 H[pl.ds(r + 1, 1)] = row.reshape(1, 1, FW)
-                return 0
+                return c + 1
 
-            jax.lax.fori_loop(0, R, dp_flat, 0)
+            steps_ref[0, 0, 0] = jax.lax.fori_loop(0, R, dp_flat, 0)
             out_ref[0, 0, 0] = H[pl.ds(R, 1)][0][0, 0]
             return
 
@@ -199,7 +220,7 @@ def build(mode: int, R: int, B: int, interpret: bool):
                 excl = jnp.where(psub >= 1, pltpu.roll(p, 1, 1), NEG)
                 return jnp.maximum(x, excl)
 
-            def dp_pair(r, _):
+            def dp_pair(r, c):
                 P = H[pl.ds(r, 1)][0]                  # (2, 8, JW)
                 scvec = jnp.where(jj2 % 4 == 1, 5, -4)
                 diag = shift1_pair(P, NEG) + scvec
@@ -207,13 +228,13 @@ def build(mode: int, R: int, B: int, interpret: bool):
                 V = jnp.where(diag >= up, diag, up)
                 row = cummax_pair(V - gp) + gp
                 H[pl.ds(r + 1, 1)] = row.reshape(1, 2, 8, JW)
-                return 0
+                return c + 1
 
-            jax.lax.fori_loop(0, R, dp_pair, 0)
+            steps_ref[0, 0, 0] = jax.lax.fori_loop(0, R, dp_pair, 0)
             out_ref[0, 0, 0] = H[pl.ds(R, 1)][0][0, 0, 0]
             return
 
-        if mode in (9, 10):
+        if mode in (9, 10, 12):
             # v3 lane-lockstep row shape: (JC, 8, 128), window g in
             # sublane g; ring of RING H rows; lane-radix-4 + chunk-prefix
             # cummax (no cross-sublane carries — windows are independent)
@@ -260,7 +281,7 @@ def build(mode: int, R: int, B: int, interpret: bool):
                 jnp.int32, (GSLOTS, 8, 128), 0)
             gls[:] = (gl_lane + gl_slot) % 7
 
-            def dp_ls(r, _):
+            def dp_ls(r):
                 P = H[pl.ds(r % RING, 1)][0]           # (JC, 8, 128)
                 if mode == 10:
                     # exr-style per-rank graph loads: a DYNAMIC-index
@@ -288,17 +309,90 @@ def build(mode: int, R: int, B: int, interpret: bool):
                 V = jnp.where(diag >= up, diag, up)
                 row = cummax_ls(V - lg) + lg
                 H[pl.ds((r + 1) % RING, 1)] = row.reshape(1, JC, 8, 128)
-                return 0
 
-            jax.lax.fori_loop(0, R, dp_ls, 0)
+            if mode == 12:
+                # the ls colstep path: two unconditional ranks per serial
+                # iteration (poa_pallas_ls.py pair_body), trailing rank
+                # guarded for odd R
+                def pair_ls(p, c):
+                    r = 2 * p
+                    dp_ls(r)
+
+                    @pl.when(r + 1 < R)
+                    def _():
+                        dp_ls(r + 1)
+
+                    return c + 1
+
+                iters = jax.lax.fori_loop(0, (R + 1) // 2, pair_ls, 0)
+            else:
+                def one_ls(r, c):
+                    dp_ls(r)
+                    return c + 1
+
+                iters = jax.lax.fori_loop(0, R, one_ls, 0)
+            steps_ref[0, 0, 0] = iters
             hr = H[pl.ds(R % RING, 1)][0]
             out_ref[0, 0, 0] = hr[0, 0, 0] + hr[0, 0, 1]
+            return
+
+        if mode in (13, 14):
+            # aligner band-loop shape: one (1, 128) band row carried in
+            # registers, shift + select recurrence per DP row (the
+            # Hirschberg edge kernel's serial chain without its DMA).
+            # mode 13 loads one scalar query code per row; mode 14 loads
+            # one packed word per iteration and scores 4 byte-extracted
+            # rows (align_pallas.py pack path)
+            alane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+            row0 = alane * G + seed_ref[0, 0, 0]
+
+            def astep(qc, row):
+                scvec = jnp.where(alane % 5 == qc, 5, -4)
+                dshift = jnp.where(alane == 0, NEG, pltpu.roll(row, 1, 1))
+                diag = dshift + scvec
+                up = row + G
+                return jnp.where(diag >= up, diag, up)
+
+            if mode == 13:
+                base[:] = nn_i % 5         # query codes, one per slot
+
+                def arow(i, c):
+                    row, s = c
+                    qc = loadn(base[:], i)
+                    return (astep(qc, row), s + 1)
+
+                row, iters = jax.lax.fori_loop(
+                    0, R, arow, (row0, jnp.int32(0)))
+            else:
+                # slot w holds codes 4w..4w+3, one byte each (the
+                # encoding.pack_bases layout)
+                pw = jnp.zeros_like(nn_i)
+                for p in range(4):
+                    pw = pw + (((4 * nn_i + p) % 5) << (8 * p))
+                base[:] = pw
+
+                def arow4(it, c):
+                    row, s = c
+                    qword = loadn(base[:], it)
+                    for p in range(4):
+                        i = it * 4 + p
+                        qc = (qword >> (8 * p)) & 0xFF
+                        row = jnp.where(i < R, astep(qc, row), row)
+                    return (row, s + 1)
+
+                row, iters = jax.lax.fori_loop(
+                    0, (R + 3) // 4, arow4, (row0, jnp.int32(0)))
+            steps_ref[0, 0, 0] = iters
+            out_ref[0, 0, 0] = row[0, 0] + row[0, 1]
             return
 
         # graph state init (content irrelevant; loads must be real)
         order[:] = nn_i
         base[:] = nn_i % 4
-        key[:] = nn_i.astype(jnp.float32)
+        # mode 11: synthetic multiplicity-2 column keys — every adjacent
+        # rank pair shares a column, so the colstep loop runs at its 2x
+        # compression ceiling (the NODE_GROWTH=2.0 expectation)
+        key[:] = ((nn_i // 2) if mode == 11 else nn_i).astype(jnp.float32)
         in_cnt[:] = jnp.where(nn_i > 0, 2, 0)
         in_src[:] = jnp.zeros((E, 8, NW), jnp.int32)
         in_src[0:1] = jnp.maximum(nn_i - 1, 0).reshape(1, 8, NW)
@@ -308,10 +402,11 @@ def build(mode: int, R: int, B: int, interpret: bool):
         H[0:1] = (gvec + seed_ref[0, 0, 0]).reshape(1, 8, JW)
 
         # modes 5 and 7 are row-math variants of mode 0: no graph-state
-        # machinery, or their deltas vs mode 0 would be confounded
-        level = 0 if mode in (5, 7) else mode
+        # machinery, or their deltas vs mode 0 would be confounded;
+        # mode 11 is mode 1's body under the column-compressed loop
+        level = 0 if mode in (5, 7) else 1 if mode == 11 else mode
 
-        def dp(r, _):
+        def dp_work(r):
             if level >= 1:
                 u = loadn(order[:], r)
             else:
@@ -354,9 +449,35 @@ def build(mode: int, R: int, B: int, interpret: bool):
             V = jnp.where(diag >= up, diag, up)
             row = cummaxj(V - gvec) + gvec
             H[pl.ds(u + 1, 1)] = row.reshape(1, 8, JW)
-            return 0
 
-        jax.lax.fori_loop(0, R, dp, 0)
+        if mode == 11:
+            # the v2 colstep while_loop (poa_pallas.py): retire rank r,
+            # and r+1 too when it shares r's column key
+            def col_cond(c):
+                return c[0] < R
+
+            def col_body(c):
+                r, s = c
+                dp_work(r)
+                ku = loadn(key[:], loadn(order[:], r))
+                k2 = loadn(key[:], loadn(order[:], r + 1))
+                pair = (r + 1 < R) & (k2 == ku)
+
+                @pl.when(pair)
+                def _():
+                    dp_work(r + 1)
+
+                return (r + 1 + pair.astype(jnp.int32), s + 1)
+
+            _, iters = jax.lax.while_loop(
+                col_cond, col_body, (jnp.int32(0), jnp.int32(0)))
+        else:
+            def dp(r, c):
+                dp_work(r)
+                return c + 1
+
+            iters = jax.lax.fori_loop(0, R, dp, 0)
+        steps_ref[0, 0, 0] = iters
         # tap two lanes: a single lane can legitimately saturate to NEG in
         # the stripped-down modes, which would false-positive the seed check
         hr = H[pl.ds(R, 1)][0]
@@ -367,14 +488,17 @@ def build(mode: int, R: int, B: int, interpret: bool):
         grid=(B,),
         in_specs=[pl.BlockSpec((1, 1, 1), lambda b: (b, 0, 0),
                                memory_space=pltpu.SMEM)],
-        out_specs=pl.BlockSpec((1, 1, 1), lambda b: (b, 0, 0),
-                               memory_space=pltpu.SMEM),
-        out_shape=jax.ShapeDtypeStruct((B, 1, 1), jnp.int32),
+        out_specs=[pl.BlockSpec((1, 1, 1), lambda b: (b, 0, 0),
+                                memory_space=pltpu.SMEM),
+                   pl.BlockSpec((1, 1, 1), lambda b: (b, 0, 0),
+                                memory_space=pltpu.SMEM)],
+        out_shape=[jax.ShapeDtypeStruct((B, 1, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((B, 1, 1), jnp.int32)],
         scratch_shapes=[
             pltpu.VMEM((R + 1, 1, 8 * JW) if mode == 6 else
                        (R + 1, 2, 8, JW) if mode == 8 else
-                       (RING, JC, 8, 128) if mode in (9, 10) else
-                       (R + 1, 8, JW), jnp.int32),   # H (ring for 9/10)
+                       (RING, JC, 8, 128) if mode in (9, 10, 12) else
+                       (R + 1, 8, JW), jnp.int32),   # H (ring, 9/10/12)
             pltpu.VMEM((8, NW), jnp.int32),          # order
             pltpu.VMEM((8, NW), jnp.int32),          # base
             pltpu.VMEM((8, NW), jnp.float32),        # key
@@ -388,7 +512,42 @@ def build(mode: int, R: int, B: int, interpret: bool):
     return jax.jit(lambda seed: call(seed))
 
 
+def gate(R: int = 32, B: int = 1) -> bool:
+    """The CI serial-step gate: measured trip counts of the compressed
+    modes vs their baselines.  Runs in interpret mode off-TPU (counts,
+    not wall time, are the measurement), prints every ratio, returns
+    False if any floor is missed."""
+    from racon_tpu.tools import force_cpu_if_requested
+    force_cpu_if_requested()
+    import jax
+
+    interp = jax.devices()[0].platform != "tpu"
+    seed = np.zeros((B, 1, 1), np.int32)
+
+    def steps_of(mode):
+        _, steps = build(mode, R, B, interp)(seed)
+        jax.block_until_ready(steps)
+        return int(np.asarray(steps)[0, 0, 0])
+
+    checks = (("poa-v2 colstep", 1, 11, 1.5),
+              ("poa-ls rank-pair", 9, 12, 1.5),
+              ("align row-pack", 13, 14, 2.0))
+    ok = True
+    for name, base_m, new_m, floor in checks:
+        b, n = steps_of(base_m), steps_of(new_m)
+        ratio = b / n if n else float("inf")
+        good = ratio >= floor
+        ok = ok and good
+        print(f"{name}: baseline mode {base_m} = {b} serial steps, "
+              f"compressed mode {new_m} = {n}, measured ratio "
+              f"{ratio:.2f}x (floor {floor}x) "
+              f"{'OK' if good else 'FAIL'}")
+    return ok
+
+
 def main():
+    if "--gate" in sys.argv[1:]:
+        sys.exit(0 if gate() else 1)
     R = int(sys.argv[1]) if len(sys.argv) > 1 else 800
     B = int(sys.argv[2]) if len(sys.argv) > 2 else 16
     reps = int(sys.argv[3]) if len(sys.argv) > 3 else 3
@@ -405,30 +564,32 @@ def main():
     interp = platform != "tpu"
     print(f"platform={platform} R={R} B={B}")
     prev = 0.0
-    for mode in range(11):
+    for mode in range(15):
         fn = build(mode, R, B, interp)
         seed = np.zeros((B, 1, 1), np.int32)
         t0 = time.time()
-        out = fn(seed)
+        out, steps = fn(seed)
         jax.block_until_ready(out)
         first = time.time() - t0
         # sanity: the result must move with the seed, else the kernel was
         # folded away and the timing is fiction
         o1 = int(np.asarray(out)[0, 0, 0])
-        o2 = int(np.asarray(fn(seed + 7))[0, 0, 0])
+        o2 = int(np.asarray(fn(seed + 7)[0])[0, 0, 0])
+        st = int(np.asarray(steps)[0, 0, 0])
         best = None
         for i in range(reps):
             t0 = time.time()
             jax.block_until_ready(fn(seed + i + 1))
             dt = time.time() - t0
             best = dt if best is None else min(best, dt)
-        rows = R * B * (2 if mode == 8 else 8 if mode in (9, 10) else 1)
+        rows = R * B * (2 if mode == 8 else
+                        8 if mode in (9, 10, 12) else 1)
         per_node_us = best / rows * 1e6
         folded = " [FOLDED? output ignores seed — timing is fiction]" \
             if o1 == o2 else ""
         print(f"mode={mode} first={first:.2f}s warm={best:.4f}s "
               f"per_node={per_node_us:.3f}us delta={per_node_us - prev:+.3f}"
-              f"us out(seed0)={o1} out(seed7)={o2}{folded}")
+              f"us steps={st} out(seed0)={o1} out(seed7)={o2}{folded}")
         prev = per_node_us
 
 

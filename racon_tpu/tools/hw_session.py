@@ -40,6 +40,9 @@ Priorities (unchanged):
   2. bench        — python bench.py at the default 0.5 Mbp (45 min)
   3. bench_sam    — SAM input (no alignment phase): consensus ls tier
   4. bench_sam_v2 — same with RACON_TPU_POA_KERNEL=v2
+  4a. bench_sam_flat / bench_sam_v2_flat — the same two tiers with
+      RACON_TPU_POA_COLSTEP=0 (flat one-rank-per-step loops): the
+      compressed-vs-current serial-step A/B on silicon
   4b. bench_sam_xla64 — vmapped XLA kernel at RACON_TPU_BATCH_WINDOWS=64
   4c. bench_sam_sr — short-read profile consensus bench
   5. bench5       — RACON_TPU_BENCH_MBP=5 scale run (90 min)
@@ -90,6 +93,16 @@ STEPS = [
      {"RACON_TPU_BENCH_INPUT": "sam"}),
     ("bench_sam_v2", [sys.executable, "bench.py"], 2700,
      {"RACON_TPU_BENCH_INPUT": "sam", "RACON_TPU_POA_KERNEL": "v2"}),
+    # column-compression A/B on silicon: the same two tiers with the
+    # compressed stepping disabled (one rank per serial iteration) —
+    # the measured delta against bench_sam / bench_sam_v2 is the
+    # serial-step cut's hardware evidence (each step checkpoints, so a
+    # dropped tunnel resumes at the missing half of the pair)
+    ("bench_sam_flat", [sys.executable, "bench.py"], 2700,
+     {"RACON_TPU_BENCH_INPUT": "sam", "RACON_TPU_POA_COLSTEP": "0"}),
+    ("bench_sam_v2_flat", [sys.executable, "bench.py"], 2700,
+     {"RACON_TPU_BENCH_INPUT": "sam", "RACON_TPU_POA_KERNEL": "v2",
+      "RACON_TPU_POA_COLSTEP": "0"}),
     # the third consensus tier: the vmapped XLA kernel at a wide batch —
     # the cost model's "decisive alternative" (if XLA lowers the graph
     # gathers well it is bandwidth-bound rather than latency-bound and

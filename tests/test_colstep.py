@@ -1,0 +1,233 @@
+"""Column-compressed POA stepping (ops/colstep.py, the colstep paths in
+poa_pallas.py / poa_pallas_ls.py) and the packed aligner DP
+(encoding.pack_bases, the pack paths in align_pallas.py).
+
+Three layers of coverage, all interpret mode on the CPU backend:
+
+* rank -> column-step mapping unit tests (chain, bubble, branch-heavy
+  graphs) against the numpy twin `colstep.pair_schedule`;
+* packed-encoding round trips (encoding.pack_bases / unpack_bases);
+* byte-identity: the compressed kernels against their flat-loop
+  variants and the host oracle across a depth x length grid, the packed
+  aligner against the unpacked one, and an end-to-end polish under
+  RACON_TPU_FAULT lattice demotion (v2-with-colstep serving most
+  windows, the quarantined one demoted to host).
+
+The serial-step GATE (measured loop trip counts, >= 1.5x POA / >= 2x
+aligner) runs through racon_tpu/tools/dp_cost_probe.py --gate and is
+asserted here so tier-1 CI enforces it.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from racon_tpu import native
+from racon_tpu.ops import colstep, encoding, poa_pallas, poa_pallas_ls
+from racon_tpu.ops.encoding import decode, encode
+
+from tests.test_pallas import mutate
+from tests.test_pallas_ls import CFG, _alloc, _set_window
+
+
+# --------------------------------------------- rank -> column-step map
+
+def test_pair_schedule_chain():
+    # linear chain: all keys distinct -> no compression
+    keys = [0.0, 1.0, 2.0, 3.0]
+    assert colstep.pair_schedule(keys) == [(0, 1), (1, 1), (2, 1), (3, 1)]
+    assert colstep.n_column_steps(keys) == 4
+    assert colstep.compression(keys) == 1.0
+
+
+def test_pair_schedule_bubble():
+    # SNP bubble: two alternative bases share column 1
+    keys = [0.0, 1.0, 1.0, 2.0]
+    assert colstep.pair_schedule(keys) == [(0, 1), (1, 2), (3, 1)]
+    assert colstep.n_column_steps(keys) == 3
+
+
+def test_pair_schedule_branch_heavy():
+    # multiplicity-3 column takes ceil(3/2) greedy steps; the
+    # multiplicity-2 column pairs fully
+    keys = [0.0, 1.0, 1.0, 1.0, 2.0, 2.0]
+    assert colstep.pair_schedule(keys) == [(0, 1), (1, 2), (3, 1), (4, 2)]
+    assert colstep.n_column_steps(keys) == 4
+    assert colstep.compression(keys) == pytest.approx(6 / 4)
+
+
+def test_pair_schedule_pack_ceiling_and_empty():
+    keys = [5.0] * 8   # degenerate single column
+    assert colstep.n_column_steps(keys) == 4
+    assert colstep.compression(keys) == colstep.PACK
+    assert colstep.pair_schedule([]) == []
+    assert colstep.compression([]) == 1.0
+
+
+def test_pair_schedule_covers_every_rank_once():
+    rng = random.Random(11)
+    keys = sorted(rng.choice((0.5, 1.0, 1.5, 2.0, 2.25, 3.0))
+                  for _ in range(37))
+    seen = []
+    for r, take in colstep.pair_schedule(keys):
+        seen.extend(range(r, r + take))
+    assert seen == list(range(len(keys)))
+
+
+# --------------------------------------------------- packed encoding
+
+def test_pack_bases_round_trip():
+    rng = np.random.default_rng(3)
+    for n in (0, 1, 3, 4, 5, 127, 128, 1000):
+        codes = rng.integers(0, 5, size=n).astype(np.int32)
+        words = encoding.pack_bases(codes)
+        assert words.shape[-1] == (n + encoding.PACK - 1) // encoding.PACK
+        np.testing.assert_array_equal(encoding.unpack_bases(words, n),
+                                      codes)
+
+
+def test_pack_bases_width_and_batch():
+    codes = (np.arange(10, dtype=np.int32) % 5).reshape(2, 5)
+    words = encoding.pack_bases(codes, width=128)
+    assert words.shape == (2, 128)
+    np.testing.assert_array_equal(encoding.unpack_bases(words, 5), codes)
+
+
+def test_pack_bases_is_lossless_for_code4():
+    # why packing is byte-per-code, not 2-bit: code 4 (N) must survive
+    codes = np.full(9, 4, np.int32)
+    np.testing.assert_array_equal(
+        encoding.unpack_bases(encoding.pack_bases(codes), 9), codes)
+
+
+# --------------------------------- kernel byte-identity (interpret mode)
+
+def _window_batch(rng, B, cfg, depths, lengths, rate=0.1):
+    a = _alloc(B, cfg)
+    cases = []
+    for b in range(B):
+        truth = bytes(rng.choice(b"ACGT") for _ in range(lengths[b]))
+        backbone = mutate(truth, rate, rng)
+        layers = [mutate(truth, rate, rng) for _ in range(depths[b])]
+        _set_window(a, b, backbone, layers)
+        cases.append((backbone, layers))
+    return a, cases
+
+
+def _call(fn, a):
+    return tuple(np.asarray(x) for x in fn(
+        a["bb_len"][:, None], a["nl"][:, None], a["lens"], a["bg"],
+        a["en"], a["bb"].astype(np.int32), a["bbw"],
+        a["seqs"].astype(np.int32), a["ws"]))
+
+
+def test_v2_colstep_byte_identical_across_grid():
+    """Compressed vs flat v2 loop on a depth x length grid: every output
+    array identical, and the consensus equals the host oracle."""
+    rng = random.Random(19)
+    B = 4
+    a, cases = _window_batch(rng, B, CFG, depths=[2, 4, 6, 8],
+                             lengths=[40, 70, 100, 120])
+    on = _call(poa_pallas.build_pallas_poa_kernel(
+        CFG, interpret=True, colstep=True)(B), a)
+    off = _call(poa_pallas.build_pallas_poa_kernel(
+        CFG, interpret=True, colstep=False)(B), a)
+    for x, y in zip(on, off):
+        np.testing.assert_array_equal(x, y)
+    cb, cc, cl, fl, nn = on
+    assert not fl.any()
+    for b, (backbone, layers) in enumerate(cases):
+        host, _ = native.window_consensus(backbone, layers, trim=False)
+        assert decode(cb[b, :cl[b, 0]]) == host
+
+
+def test_ls_colstep_byte_identical_across_grid():
+    """Compressed (rank-pair) vs flat lockstep loop on one 8-window
+    batch of varying depth/length, including a padding window."""
+    rng = random.Random(29)
+    B = 8
+    a, cases = _window_batch(rng, B, CFG, depths=[2, 3, 4, 5, 6, 4, 3, 2],
+                             lengths=[40, 55, 70, 85, 100, 60, 45, 30])
+    # w7 -> padding window (1-base backbone, zero layers)
+    a["bb"][7] = 0
+    a["bb_len"][7] = 1
+    a["nl"][7] = 0
+    a["lens"][7] = 0
+    on = _call(poa_pallas_ls.build_lockstep_poa_kernel(
+        CFG, interpret=True, colstep=True)(B), a)
+    off = _call(poa_pallas_ls.build_lockstep_poa_kernel(
+        CFG, interpret=True, colstep=False)(B), a)
+    for x, y in zip(on, off):
+        np.testing.assert_array_equal(x, y)
+    cb, cc, cl, fl, nn = on
+    assert not fl.any()
+    for b, (backbone, layers) in enumerate(cases[:7]):
+        host, _ = native.window_consensus(backbone, layers, trim=False)
+        assert decode(cb[b, :cl[b, 0]]) == host
+
+
+def test_align_pack_byte_identical(monkeypatch):
+    """Packed (4 rows/step) vs unpacked Hirschberg aligner: identical op
+    paths on multi-bucket input."""
+    from racon_tpu.ops import align_pallas
+
+    rng = random.Random(23)
+    pairs = []
+    for n in (150, 300, 700):
+        q = bytes(rng.choice(b"ACGT") for _ in range(n))
+        pairs.append((q, mutate(q, 0.08, rng)))
+    enc = [(encode(np.frombuffer(q, np.uint8)).astype(np.int32),
+            encode(np.frombuffer(t, np.uint8)).astype(np.int32))
+           for q, t in pairs]
+
+    def run(flag):
+        monkeypatch.setenv("RACON_TPU_ALIGN_PACK", flag)
+        align_pallas._build_edge_kernel.cache_clear()
+        align_pallas._build_base_kernel.cache_clear()
+        try:
+            return align_pallas.align_pairs(enc, interpret=True)
+        finally:
+            align_pallas._build_edge_kernel.cache_clear()
+            align_pallas._build_base_kernel.cache_clear()
+
+    packed = run("1")
+    flat = run("0")
+    for p_ops, f_ops in zip(packed, flat):
+        assert p_ops is not None and f_ops is not None
+        np.testing.assert_array_equal(p_ops, f_ops)
+
+
+def test_colstep_polish_byte_identical_under_fault_demotion(tmp_path,
+                                                            monkeypatch):
+    """End-to-end polish with the colstep v2 kernel serving, one window
+    poisoned via RACON_TPU_FAULT and demoted down the lattice: the
+    polished output stays byte-identical to the CPU oracle."""
+    from tests.test_faults import (_assert_report_sums, _oracle, _tpu_run,
+                                   _write_dataset)
+
+    paths = _write_dataset(tmp_path)
+    oracle = _oracle(paths)
+    res, p = _tpu_run(paths, monkeypatch, {
+        "RACON_TPU_PALLAS": "1",
+        "RACON_TPU_FAULT": "poa.run.v2:window=2",
+    })
+    assert res == oracle
+    d = _assert_report_sums(p)
+    cons = d["phases"]["consensus"]
+    assert cons["served"]["v2"] == 5 and cons["served"]["host"] == 1
+    assert cons["quarantined"] == [2]
+
+
+# ------------------------------------------------ serial-step gate (CI)
+
+def test_probe_serial_step_gate(capsys):
+    """The dp_cost_probe gate: measured loop trip counts of the
+    compressed modes vs their baselines must clear the floors (>= 1.5x
+    for both POA shapes, >= 2x for the packed aligner)."""
+    from racon_tpu.tools import dp_cost_probe
+
+    assert dp_cost_probe.gate()
+    out = capsys.readouterr().out
+    assert out.count("OK") == 3 and "FAIL" not in out
+    assert "measured ratio" in out

@@ -33,6 +33,9 @@ def test_grid_constants_match_ops_modules():
     assert costmodel.AUDIT_WINDOW_LENGTHS == poa_driver.AUDIT_WINDOW_LENGTHS
     assert costmodel.ALIGN_BUCKETS == align.BUCKETS
     assert costmodel.LS_GROUP == poa_pallas_ls.G
+    from racon_tpu.ops import colstep, encoding
+    assert costmodel.POA_COLSTEP_PACK == colstep.PACK
+    assert costmodel.ALIGN_ROW_PACK == encoding.PACK
     for bb in (1, 100, 128, 129, 500, 1000, 1024):
         assert costmodel.window_class(bb) == poa_driver.window_class(bb)
     # band_need is the `need` inside align_pallas.band_for: the bucket
@@ -60,6 +63,20 @@ def test_ls_tier_amortizes_serial_steps_by_group():
     ls = costmodel.poa_window_cost(32, 512, "ls")
     assert ls.flops == v2.flops and ls.hbm_bytes == v2.hbm_bytes
     assert v2.serial_steps == ls.serial_steps * costmodel.LS_GROUP
+
+
+def test_colstep_pack_divides_pallas_tier_serial_steps():
+    """Column compression only helps the Pallas loops; the XLA twin
+    still retires one rank per scan step."""
+    xla = costmodel.poa_window_cost(32, 512, "xla")
+    v2 = costmodel.poa_window_cost(32, 512, "v2")
+    assert xla.serial_steps == v2.serial_steps * costmodel.POA_COLSTEP_PACK
+    assert xla.flops == v2.flops and xla.hbm_bytes == v2.hbm_bytes
+
+
+def test_row_pack_divides_hirschberg_serial_steps():
+    hs = costmodel.align_job_cost(1024, 256, "hirschberg")
+    assert hs.serial_steps == 4.0 * 1024 / costmodel.ALIGN_ROW_PACK
 
 
 def test_poa_window_cost_scales_with_depth_and_class():
@@ -395,7 +412,10 @@ def test_cost_hooks_estimate_maps_builders():
     est = cost_hooks.estimate("build_poa_kernel", (cfg,), {})
     assert est == costmodel.poa_window_cost(32, cfg.max_backbone, "xla")
     est_ls = cost_hooks.estimate("build_lockstep_poa_kernel", (cfg,), {})
-    assert est_ls.serial_steps * costmodel.LS_GROUP == est.serial_steps
+    # xla keeps the one-rank-per-step scan; the ls tier amortizes by
+    # LS_GROUP *and* pairs ranks via column compression
+    assert (est_ls.serial_steps * costmodel.LS_GROUP
+            * costmodel.POA_COLSTEP_PACK == est.serial_steps)
     est_a = cost_hooks.estimate("build_align_kernel", (1024, 256), {})
     assert est_a == costmodel.align_job_cost(1024, 256, "xla")
     assert cost_hooks.estimate("build_mystery_kernel", (1,), {}) is None

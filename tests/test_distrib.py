@@ -296,7 +296,7 @@ def test_bench_distrib_entry_normalizes_as_fixed_point():
         "metric": "distrib: polished Mbp/sec (synthetic ONT 0.5 Mbp 30x, "
                   "PAF, w=500, 3 workers/6 chunks, end-to-end)",
         "value": 2.34, "unit": "Mbp/s", "vs_baseline": None,
-        "cost_model": None, "pack_split": None,
+        "cost_model": None, "pack_split": None, "serial_steps": None,
         "distrib": {"workers": 3, "chunks": 6,
                     "served": {"fleet": 6, "local": 0},
                     "redispatches": 1, "journal_replayed": 2},
