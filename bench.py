@@ -140,7 +140,7 @@ def pallas_compiles(timeout_s: int = 900):
             "import __graft_entry__ as g\n"
             "kind = %r\n"
             "cfg = poa_driver.make_config(500, 8, 5, -4, -8)\n"
-            "B = poa_driver._device_batch(poa_driver._n_devices(), kind)\n"
+            "B = poa_driver._device_batch(kind)\n"
             "fn = poa_driver._build_kernel(cfg, B, True, kind)\n"
             "packed = g._example_batch(cfg, B, np.random.default_rng(0))\n"
             "out = poa_driver._submit(fn, packed, True)\n"
@@ -768,6 +768,73 @@ def distrib_profile(workers: int = 3) -> int:
     return 0 if served_total == result["chunks"] else 1
 
 
+def multichip_profile(counts=(1, 2, 4, 8), repeats: int = 3) -> int:
+    """`python bench.py multichip`: the device-count scaling sweep as a
+    bench series.
+
+    Runs tools/multichip.py's sweep (one bounded subprocess per mesh
+    width; the partitioner under-subscribes the visible devices via
+    RACON_TPU_MESH_SHAPE) and stamps windows/s at the widest mesh as the
+    value, with every per-count measurement under "multichip" — so the
+    `obs bench` regression gate trends the sharded dispatch path.  The
+    `profile: multichip-<PROFILE>` field keeps it its own series.  On
+    anything but a healthy real TPU the sweep runs on forced virtual CPU
+    devices, which share the host's cores: the entry is marked
+    `forced` (rehearsal, never device evidence — the silicon curve comes
+    from hw_session's checkpointed multichip step).  vs_baseline is
+    null: scaling vs the 1-device row IS the metric, not a ratio against
+    the CPU oracle."""
+    from racon_tpu.tools import multichip as mc
+
+    real = device_healthy() and not _forced_device()
+    results = mc.sweep(sorted(set(counts)), repeats=repeats, real=real)
+    ok = {n: e for n, e in results.items() if e.get("ok")
+          and e.get("windows_per_s")}
+    if not ok:
+        print("[bench] multichip: every sweep count failed", file=sys.stderr)
+        print(json.dumps(results, indent=2), file=sys.stderr)
+        return 1
+    top = max(ok, key=int)
+    value = ok[top]["windows_per_s"]
+    tier = ok[top]["tier"]
+    tag = "" if real else " [FORCED DRY-RUN: not device evidence]"
+    mc_stats = {
+        "counts": results,
+        "scaling_vs_1": (round(value / ok["1"]["windows_per_s"], 3)
+                         if ok.get("1") and ok["1"]["windows_per_s"]
+                         else None),
+    }
+    entry = {
+        "metric": f"multichip: sharded consensus windows/sec at {top} "
+                  f"device(s) (counts {sorted(map(int, results))}, "
+                  f"tier {tier}, batch {ok[top]['batch']}){tag}",
+        "value": round(value, 2),
+        "unit": "windows/s",
+        # no paired oracle run in the sweep — explicit nulls keep
+        # normalize_entry a fixed point on fresh entries
+        "vs_baseline": None,
+        "cost_model": None,
+        "pack_split": None,
+        "serial_steps": None,
+        "multichip": mc_stats,
+        **({"forced": True} if not real else {}),
+    }
+    assert normalize_entry(dict(entry)) == entry, \
+        "multichip bench entry must be a normalize_entry fixed point"
+    log_device_measurement({
+        "mbp": MBP, "input": INPUT, "profile": f"multichip-{PROFILE}",
+        "value": round(value, 2), "vs_baseline": None,
+        "kernel": tier, "multichip": mc_stats,
+        "cost_model": None, "pack_split": None, "serial_steps": None,
+        **({"forced": True} if not real else {}),
+    })
+    print(json.dumps(entry))
+    print(f"[bench] multichip: {len(ok)}/{len(results)} counts measured, "
+          f"{top}-device {value:.1f} windows/s "
+          f"(x{mc_stats['scaling_vs_1']} vs 1 device)", file=sys.stderr)
+    return 0 if len(ok) == len(results) else 1
+
+
 def _opportunistic_golden(tier, timeout_s: int = 900):
     """Healthy chip in hand: also re-measure the λ device golden, bounded.
 
@@ -814,4 +881,6 @@ if __name__ == "__main__":
         sys.exit(serve_profile())
     if len(sys.argv) > 1 and sys.argv[1] == "distrib":
         sys.exit(distrib_profile())
+    if len(sys.argv) > 1 and sys.argv[1] == "multichip":
+        sys.exit(multichip_profile())
     main()

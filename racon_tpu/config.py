@@ -82,6 +82,15 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
     _k("RACON_TPU_COMPILE_CACHE", None, "str",
        "persistent XLA compilation cache directory (default: uid-keyed "
        "~/.cache path)"),
+    _k("RACON_TPU_SHARD", "1", "bool",
+       "shard kernel batches over the device mesh (0 forces "
+       "single-device dispatch; output is byte-identical either way)"),
+    _k("RACON_TPU_MESH_SHAPE", None, "str",
+       "device mesh as 'data[,model]' (e.g. '8' or '4,2'; default: all "
+       "devices on the data axis)"),
+    _k("RACON_TPU_SHARD_MIN_BATCH", "0", "int",
+       "smallest batch worth sharding (0 = one row per mesh shard); "
+       "smaller batches dispatch single-device without padding"),
     _k("RACON_TPU_FORCE_CPU", None, "bool",
        "force the virtual-CPU backend before jax initializes (tools)",
        scope="tools"),

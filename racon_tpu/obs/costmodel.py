@@ -379,6 +379,30 @@ def model_rows(prof: MachineProfile,
 _POA_CELLS = re.compile(r"^poa\.cells\.d(\d+)\.c(\d+)$")
 _POA_WINDOWS = re.compile(r"^poa\.windows\.d(\d+)\.c(\d+)$")
 _ALIGN_CELLS = re.compile(r"^align\.cells\.c(\d+)$")
+_SHARD_ROWS = re.compile(r"^shard\.rows\.d(\d+)$")
+
+
+def infer_n_devices(counters: Dict[str, int]) -> int:
+    """Device count from the per-device shard-row counters the executor
+    emits on every sharded dispatch (`shard.rows.d<i>`); 1 when the run
+    never sharded."""
+    n = 0
+    for k in counters:
+        m = _SHARD_ROWS.match(k)
+        if m:
+            n = max(n, int(m.group(1)) + 1)
+    return max(1, n)
+
+
+def _over_devices(est: CostEstimate, n: int) -> CostEstimate:
+    """Spread a device-side estimate over n mesh shards: FLOPs and HBM
+    traffic divide (data-parallel rows), the latency-chained serial
+    steps do NOT — every shard runs the same lockstep DP loop on its
+    slice, concurrently."""
+    if n <= 1:
+        return est
+    return CostEstimate(est.flops / n, est.hbm_bytes / n,
+                        est.serial_steps)
 
 #: Trace phase span name -> run-report phase name (bench.py's
 #: `phase_wall` keys use the report names).
@@ -408,7 +432,8 @@ def _dominant_tier(counters: Dict[str, int], phase: str,
 
 
 def predict_from_counters(counters: Dict[str, int],
-                          prof: MachineProfile) -> dict:
+                          prof: MachineProfile,
+                          n_devices: Optional[int] = None) -> dict:
     """Turn the measured-cell counters (the drivers count them per
     bucket, see docs/observability.md) into predicted per-phase walls
     plus a per-bucket table.
@@ -418,7 +443,19 @@ def predict_from_counters(counters: Dict[str, int],
     Aligner: `align.cells.c<CAP>` = padded cap x band DP cells per xla
     bucket, `align.cells.hirschberg` likewise, `align.cells.total` the
     need-band cells over ALL phase-1 jobs (host share included).
+
+    `n_devices` divides the device-side FLOP/byte bill (data-parallel
+    mesh sharding; serial steps are NOT divided — shards run their DP
+    loops concurrently).  None = infer from the `shard.rows.d<i>`
+    counters, EXCEPT on the cpu-host profile, where forced-host virtual
+    devices share the same cores and sharding adds no real throughput
+    (the CI `obs validate` bound must not assume an 8x that can't
+    exist); an explicit count always wins.
     """
+    if n_devices is None:
+        n_devices = (1 if prof.name == "cpu-host"
+                     else infer_n_devices(counters))
+    n_devices = max(1, int(n_devices))
     # ---- consensus / POA
     tier = _dominant_tier(counters, "consensus", POA_TIERS) or "v2"
     total_served = sum(v for k, v in counters.items()
@@ -442,7 +479,7 @@ def predict_from_counters(counters: Dict[str, int],
                            steps1 * POA_LAYER_BYTES,
                            ranks_steps / step_div)
         dev_share = 1.0 - host_frac
-        dev_est = est.scaled(dev_share)
+        dev_est = _over_devices(est.scaled(dev_share), n_devices)
         sec, verdict = roofline(dev_est, prof)
         sec += host_poa_seconds(cells * host_frac, prof)
         windows = counters.get(f"poa.windows.d{d}.c{c}")
@@ -464,7 +501,8 @@ def predict_from_counters(counters: Dict[str, int],
             cap = int(m.group(1))
             band = dict(ALIGN_BUCKETS).get(cap, cap // 4)
             jobs = max(1, raw // (cap * band))
-            est = align_job_cost(cap, band, "xla").scaled(jobs)
+            est = _over_devices(
+                align_job_cost(cap, band, "xla").scaled(jobs), n_devices)
             a_est = a_est.plus(est)
             dev_cells += float(raw)
             sec, verdict = roofline(est, prof)
@@ -473,9 +511,11 @@ def predict_from_counters(counters: Dict[str, int],
                             "predicted_s": sec, "verdict": verdict})
     hs_cells = counters.get("align.cells.hirschberg", 0)
     if hs_cells:
-        est = CostEstimate(hs_cells * ALIGN_FLOPS_PER_CELL,
-                           hs_cells * 0.1,
-                           hs_cells * (4.0 / ALIGN_ROW_PACK) / 256.0)
+        est = _over_devices(
+            CostEstimate(hs_cells * ALIGN_FLOPS_PER_CELL,
+                         hs_cells * 0.1,
+                         hs_cells * (4.0 / ALIGN_ROW_PACK) / 256.0),
+            n_devices)
         a_est = a_est.plus(est)
         dev_cells += float(hs_cells)
         sec, verdict = roofline(est, prof)
@@ -492,6 +532,7 @@ def predict_from_counters(counters: Dict[str, int],
 
     return {
         "buckets": buckets,
+        "n_devices": n_devices,
         "phases": {
             "poa": {"predicted_s": poa_s, "verdict": poa_verdict,
                     "tier": tier,
@@ -657,15 +698,20 @@ def validate_trace(doc: dict, prof: MachineProfile) -> dict:
 
 def bench_cost_model(snapshot: Optional[dict], phase_wall: Dict[str, float],
                      profile_name: str = "auto",
-                     platform: Optional[str] = None) -> Optional[dict]:
+                     platform: Optional[str] = None,
+                     n_devices: Optional[int] = None) -> Optional[dict]:
     """The `cost_model` stamp for a bench JSON entry: predicted vs
     measured per modeled phase, error %%, and the profile used.  Returns
-    None when the run collected no metrics (cost model disarmed)."""
+    None when the run collected no metrics (cost model disarmed).
+    `n_devices` threads through to predict_from_counters (None = infer
+    from shard counters on device profiles)."""
     if not snapshot or not isinstance(snapshot.get("counters"), dict):
         return None
     prof = resolve_profile(profile_name, platform)
-    pred = predict_from_counters(snapshot["counters"], prof)
-    out = {"profile": prof.name, "phases": {}}
+    pred = predict_from_counters(snapshot["counters"], prof,
+                                 n_devices=n_devices)
+    out = {"profile": prof.name, "n_devices": pred["n_devices"],
+           "phases": {}}
     ok = True
     for span_name, row in pred["phases"].items():
         report_name = PHASE_ALIASES.get(span_name, span_name)

@@ -58,8 +58,14 @@ def _bucket_for(size: int):
 
 
 @device_keyed_cache(maxsize=16)
-def build_align_kernel(cap: int, band: int):
-    """jit kernel over a batch: returns (moves-free) ops + lengths."""
+def build_align_kernel(cap: int, band: int, shard_n: int = 1):
+    """jit kernel over a batch: returns (moves-free) ops + lengths.
+
+    shard_n > 1 constrains every input/output to shard its leading
+    (``query``) batch dim over the partitioner's mesh — the pjit path;
+    the vmapped XLA program partitions transparently, no per-shard
+    rebuild needed.  Callers pad cohorts to a shard_n multiple (the
+    executor's pad seam) before dispatching on the sharded kernel."""
     K = band
     PAD = K + 2
 
@@ -129,6 +135,12 @@ def build_align_kernel(cap: int, band: int):
         ok = ok & (i == 0) & (j == 0)
         return ops, cnt, ok
 
+    if shard_n > 1:
+        from ..parallel.partitioner import get_partitioner
+
+        return get_partitioner().partition(
+            jax.vmap(one), in_axes=[("query",)] * 4,
+            out_axes=("query",))
     return jax.jit(jax.vmap(one))
 
 
@@ -178,7 +190,8 @@ class _XlaAlignOps:
         from ..resilience import faults
 
         faults.check("align.run", chunk)
-        return ctx["kernel"](*packed)
+        kern = ctx["skernel"] if ctx.get("use_shard") else ctx["kernel"]
+        return kern(*packed)
 
     def attempt(self, ctx, kind, sub):
         from ..resilience import faults
@@ -239,6 +252,37 @@ class _XlaAlignOps:
         for job in chunk:
             self.rows.pop(job, None)
 
+    # -- sharded dispatch (optional executor hooks) ------------------------
+    def shard_multiple(self, ctx, chunk):
+        # Decided per cohort: the executor pads the packed buffers to
+        # the returned multiple, then dispatch() (same submit call)
+        # routes to the sharded kernel.  Tail cohorts below the
+        # will_shard floor go single-device unpadded.  install() indexes
+        # results by real-row position, so the trailing pad rows
+        # (repeats of the last job) are computed and dropped.
+        ctx["use_shard"] = False
+        m = ctx.get("shard_n", 1)
+        if m <= 1 or ctx.get("skernel") is None:
+            return 1
+        from ..parallel.partitioner import get_partitioner
+
+        if not get_partitioner().will_shard(len(chunk)):
+            return 1
+        ctx["use_shard"] = True
+        return m
+
+    def demote_shard(self, ctx, kind, cause):
+        if not ctx.get("use_shard"):
+            return False
+        ctx["use_shard"] = False
+        ctx["shard_n"] = 1
+        from ..parallel.partitioner import get_partitioner
+        from ..resilience import lattice as rl
+
+        if get_partitioner().demote(f"{type(cause).__name__}: {cause}"):
+            rl.record_shard_demotion(self.report, kind, cause)
+        return True
+
 
 def run_jobs(pipeline, jobs, batch: int = 16, report=None,
              stats=None, lengths=None) -> int:
@@ -287,13 +331,28 @@ def run_jobs(pipeline, jobs, batch: int = 16, report=None,
     ops_obj = _XlaAlignOps(pipeline, report, stats, state)
     executor = BatchExecutor(ops_obj, report=report)
     try:
+        from ..parallel.partitioner import get_partitioner
+
+        part = get_partitioner()
+        shard_n = part.batch_axis_size if part.will_shard(batch) else 1
         for (cap, band), items in sorted(grouped.items()):
             kernel = build_align_kernel(cap, band)
+            skernel = None
+            if shard_n > 1:
+                try:
+                    skernel = build_align_kernel(cap, band, shard_n)
+                except Exception as e:  # noqa: BLE001 — shard edge
+                    # sharded wrap failed to build: single-device for
+                    # the rest of the process, same tier (never fatal)
+                    if part.demote(f"{type(e).__name__}: {e}"):
+                        rl.record_shard_demotion(report, "xla", e)
+                    shard_n = 1
             obs.count(f"align.bucket.c{cap}", len(items))
             # Measured-cell counter for the cost model (obs/costmodel.py):
             # every job in a bucket pays the full padded cap x band DP.
             obs.count(f"align.cells.c{cap}", len(items) * cap * band)
-            ctx = {"cap": cap, "band": band, "kernel": kernel}
+            ctx = {"cap": cap, "band": band, "kernel": kernel,
+                   "skernel": skernel, "shard_n": shard_n}
             for off in range(0, len(items), batch):
                 executor.submit(ctx, items[off:off + batch])
             # drain before the next bucket's kernel build so in-flight

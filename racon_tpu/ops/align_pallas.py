@@ -67,14 +67,31 @@ def _round_up(x, m):
 
 
 def _shard_over_mesh(build_local, batch, n_in, n_out):
-    """Batch-stripe a kernel build over the `windows` mesh (the shared
-    parallel.mesh.shard_batch_build wrap — same no-collective striping
-    as the consensus path; reference analogue: per-GPU aligner batches,
-    /root/reference/src/cuda/cudapolisher.cpp:96-114). None = batch
-    doesn't divide; caller uses the single-device jit."""
-    from ..parallel.mesh import shard_batch_build
+    """Batch-stripe a kernel build over the partitioner's mesh (same
+    no-collective striping as the consensus path; reference analogue:
+    per-GPU aligner batches,
+    /root/reference/src/cuda/cudapolisher.cpp:96-114).  The partitioner
+    owns the gate: RACON_TPU_SHARD, the min-batch floor, sticky
+    sharded->single-device demotion state, and divisibility.  None =
+    don't shard; caller uses the single-device jit."""
+    from ..parallel.partitioner import get_partitioner
 
-    return shard_batch_build(build_local, batch, n_in, n_out)
+    part = get_partitioner()
+    if not part.will_shard(batch):
+        return None
+    return part.shard_build(build_local, batch, n_in, n_out)
+
+
+def _dispatch_shards(batch: int) -> int:
+    """Mesh shards a `batch`-row kernel launch dispatches over — mirrors
+    _shard_over_mesh's gate so the shard-size accounting matches what
+    the (batch-keyed, topology-keyed) jitted kernel actually does."""
+    from ..parallel.partitioner import get_partitioner
+
+    part = get_partitioner()
+    m = part.batch_axis_size
+    return m if (m > 1 and batch % m == 0
+                 and part.will_shard(batch)) else 1
 
 
 # ---------------------------------------------------------------------------
@@ -553,6 +570,12 @@ def _split_round(pairs, tasks, bands, failed, interpret):
         # pad the batch dim to a power of two so each (rcap, K) bucket
         # compiles a handful of kernel variants, not one per group size
         B = _pow2(len(group))
+        m = _dispatch_shards(B)
+        if m > 1:
+            from .batch_exec import count_shard_rows
+
+            count_shard_rows(len(group), B, m)  # forward launch
+            count_shard_rows(len(group), B, m)  # backward launch
         pad = lambda a: np.concatenate(
             [a, np.repeat(a[-1:], B - len(group), axis=0)]) \
             if B > len(group) else a
@@ -593,6 +616,11 @@ def _solve_base(pairs, tasks, bands, segments, failed, interpret):
         for off in range(0, len(group), 64):
             chunk = group[off:off + 64]
             B = _pow2(len(chunk))
+            m = _dispatch_shards(B)
+            if m > 1:
+                from .batch_exec import count_shard_rows
+
+                count_shard_rows(len(chunk), B, m)
             scal = np.zeros((B, 4), np.int32)
             qraw = np.zeros((B, BASE_ROWS), np.int32)
             ts = np.full((B, TCAP), 255, np.int32)
@@ -723,6 +751,26 @@ class _HirschbergOps:
         # keep host memory O(cohort): packed views die with the chunk
         for job in chunk:
             self.pairs.pop(job, None)
+
+    # -- sharded dispatch (optional executor hook) -------------------------
+    def demote_shard(self, ctx, kind, cause):
+        # A cohort died while its round kernels could have been sharded:
+        # drop the partitioner to single-device, flush the builder
+        # caches (the batch-keyed jitted closures baked in shard_map
+        # wraps), and retry the SAME tier locally before any tier
+        # demotion — the sharded -> single-device lattice edge.
+        from ..parallel.partitioner import get_partitioner
+        from ..resilience import lattice as rl
+
+        part = get_partitioner()
+        if (part.disabled is not None or part.batch_axis_size <= 1
+                or config.get_raw("RACON_TPU_SHARD") == "0"):
+            return False
+        if part.demote(f"{type(cause).__name__}: {cause}"):
+            rl.record_shard_demotion(self.report, kind, cause)
+        _build_edge_kernel.cache_clear()
+        _build_base_kernel.cache_clear()
+        return True
 
 
 def run_jobs(pipeline, jobs, cohort: int = None, report=None,

@@ -47,12 +47,29 @@ The driver supplies an *ops* object (duck-typed; no registration):
     demote(ctx, kind, cause)  # tier died: record + return next tier
     done(ctx, chunk)          # optional: chunk fully resolved — release
                               # any per-chunk packed state
+
+Sharded dispatch (optional hooks; engines without them are untouched):
+
+    shard_multiple(ctx, chunk)  # mesh batch-axis size this chunk will
+                              # dispatch over (1 = single device).  When
+                              # >1 the executor pads the packed buffers
+                              # to that multiple HERE — the one place
+                              # pad-to-multiple math runs — and counts
+                              # the padding + per-device shard rows in
+                              # obs (`shard.pad_rows`, `shard.rows.d<i>`)
+    demote_shard(ctx, kind, cause)  # a sharded serve died: drop to
+                              # single-device dispatch and return True to
+                              # retry the SAME tier (the lattice's
+                              # `sharded -> single-device` edge); False =
+                              # not sharded, demote the tier as usual
 """
 
 from __future__ import annotations
 
 import time
 from collections import deque
+
+import numpy as np
 
 from .. import config, obs
 from ..resilience import lattice as rl
@@ -61,6 +78,43 @@ from ..resilience import lattice as rl
 def pipeline_depth() -> int:
     """How many packed chunks may be in flight on the device at once."""
     return max(1, config.get_int("RACON_TPU_PIPELINE_DEPTH"))
+
+
+def pad_to_multiple(packed, m):
+    """Pad every packed array's leading dim up to a multiple of `m` by
+    repeating the final row — valid rows recomputed and discarded, never
+    sentinel garbage, so padded lanes can't poison a kernel.  Returns
+    (padded tuple, rows added).  The round-UP replacement for the old
+    round-DOWN `parallel.mesh.divisible_batch` remainder spill; every
+    sharded engine pads through this one helper."""
+    rows = int(np.asarray(packed[0]).shape[0])
+    pad = (m - rows % m) % m
+    if pad <= 0:
+        return tuple(packed), 0
+    out = []
+    for a in packed:
+        a = np.asarray(a)
+        out.append(np.concatenate([a, np.repeat(a[-1:], pad, axis=0)],
+                                  axis=0))
+    return tuple(out), pad
+
+
+def count_shard_rows(n_real, rows, m) -> int:
+    """Shard-size observability for one sharded dispatch of `rows` rows
+    (`n_real` of them real work) over `m` mesh shards: padded-row total
+    plus one counter per device position, so shard balance ('within one
+    batch per device') is checkable from any trace snapshot.  Returns
+    the pad-row count.  Shared by the executor's pad seam and the
+    host-orchestrated Hirschberg rounds (align_pallas), which pad their
+    own pow2 batches."""
+    pad = max(0, rows - n_real)
+    if pad > 0:
+        obs.count("shard.pad_rows", pad)
+    obs.count("shard.chunks")
+    per_dev = rows // m
+    for i in range(m):
+        obs.count(f"shard.rows.d{i}", per_dev)
+    return pad
 
 
 class BatchExecutor:
@@ -78,6 +132,8 @@ class BatchExecutor:
         self._pending = deque()
         self.pack_ns = 0     # host wall: export + single-copy pack
         self.kernel_ns = 0   # host wall blocked inside the lattice serve
+        self.shard_pad_rows = 0  # rows added padding batches to a
+        #                          device multiple (sharded mode only)
 
     # -- feeding -----------------------------------------------------------
     def submit(self, ctx, idxs) -> None:
@@ -93,6 +149,12 @@ class BatchExecutor:
             self.pack_ns += time.monotonic_ns() - t0
             return
         packed = ops.pack(ctx, chunk)
+        shard_m = getattr(ops, "shard_multiple", None)
+        if packed is not None and shard_m is not None:
+            m = shard_m(ctx, chunk)
+            if m > 1:
+                packed, _ = pad_to_multiple(packed, m)
+                self._count_shard(len(chunk), packed, m)
         self.pack_ns += time.monotonic_ns() - t0
         if not getattr(ops, "async_dispatch", True):
             # host-orchestrated engine: the kernel call IS the blocking
@@ -157,6 +219,14 @@ class BatchExecutor:
             except rl.TierDead as td:
                 self.kernel_ns += time.monotonic_ns() - t0
                 outs = None
+                # sharded -> single-device is a lattice edge ABOVE tier
+                # demotion: a sharded compile failure / device loss drops
+                # to single-device dispatch and retries the SAME tier
+                # (byte-identical; sharding never changes what computes)
+                demote_shard = getattr(ops, "demote_shard", None)
+                if demote_shard is not None and demote_shard(ctx, kind,
+                                                             td.cause):
+                    continue
                 kind = ops.demote(ctx, kind, td.cause)
                 continue
             self.kernel_ns += time.monotonic_ns() - t0
@@ -172,6 +242,10 @@ class BatchExecutor:
         if done is not None:
             done(ctx, chunk)
 
+    def _count_shard(self, n_real, packed, m) -> None:
+        rows = int(np.asarray(packed[0]).shape[0])
+        self.shard_pad_rows += count_shard_rows(n_real, rows, m)
+
     # -- accounting --------------------------------------------------------
     def stamp_walls(self, report) -> None:
         """Fold the pack/kernel wall split into a PhaseReport's extras
@@ -182,3 +256,6 @@ class BatchExecutor:
             report.extra.get("pack_wall_s", 0.0) + self.pack_ns / 1e9, 6)
         report.extra["kernel_wall_s"] = round(
             report.extra.get("kernel_wall_s", 0.0) + self.kernel_ns / 1e9, 6)
+        if self.shard_pad_rows:
+            report.extra["shard_pad_rows"] = (
+                report.extra.get("shard_pad_rows", 0) + self.shard_pad_rows)

@@ -87,15 +87,29 @@ def _kernel_kind() -> str:
     return k
 
 
-def _device_batch(n_dev: int, kind: str) -> int:
-    """Batch size divisible over the mesh; the lockstep kernel additionally
-    needs the per-device batch to be a multiple of its sublane group G."""
-    from ..parallel.mesh import divisible_batch
+def _shard_n(B: int) -> int:
+    """Mesh shards this driver dispatches a B-window batch over (1 =
+    single device: sharding off, demoted, batch too small, or a 1-wide
+    batch axis)."""
+    from ..parallel.partitioner import get_partitioner
 
-    B = divisible_batch(n_dev, _batch_size())
+    part = get_partitioner()
+    return part.batch_axis_size if part.will_shard(B) else 1
+
+
+def _device_batch(kind: str) -> int:
+    """Batch size for the kernel geometry, padded UP to a mesh multiple
+    when the batch will shard (the old round-DOWN spilled remainder
+    windows to the slow path; pad rows are 1-base/0-layer windows and
+    show up in `shard.pad_rows`); the lockstep kernel additionally needs
+    the per-shard batch to be a multiple of its sublane group G."""
+    B = _batch_size()
+    m = _shard_n(B)
+    if m > 1:
+        B = ((B + m - 1) // m) * m
     if kind == "ls":
         from .poa_pallas_ls import G
-        q = G * n_dev
+        q = G * m
         B = max(1, (B + q - 1) // q) * q
     return B
 
@@ -212,9 +226,8 @@ def run_consensus_phase(pipeline, *, match: int, mismatch: int, gap: int,
     report.record_served("backbone", stats["backbone"])
 
     if jobs:
-        n_dev = _n_devices()
         requested = _kernel_kind()
-        B = _device_batch(n_dev, requested)
+        B = _device_batch(requested)
         use_pallas = _use_pallas()
         # Bucket by (depth, backbone class) to bound padding waste in BOTH
         # dims: layers dropped at pack time (oversized/empty) only shrink
@@ -380,9 +393,8 @@ def warm_geometries(window_lengths, match: int, mismatch: int,
     if isinstance(window_lengths, int):
         window_lengths = [window_lengths]
     classes = sorted({window_class(max(w, 1)) for w in window_lengths})
-    n_dev = _n_devices()
     requested = _kernel_kind()
-    B = _device_batch(n_dev, requested)
+    B = _device_batch(requested)
     use_pallas = _use_pallas()
     import itertools
     for depth_bucket, wl_class in itertools.product(DEPTH_BUCKETS, classes):
@@ -554,6 +566,24 @@ class _ConsensusOps:
         _warn_degrade(cause, nxt)
         return nxt
 
+    # -- sharded dispatch (optional executor hooks) ------------------------
+    def shard_multiple(self, ctx, chunk):
+        # _pack always pads to B, so the executor's pad-to-multiple is a
+        # no-op here; returning m>1 is purely the shard-size accounting
+        # (and must match the kernel the last live_tier built — _shard_n
+        # re-reads the same partitioner state _build_kernel keyed on)
+        m = _shard_n(self.B)
+        return m if m > 1 and self.B % m == 0 else 1
+
+    def demote_shard(self, ctx, kind, cause):
+        if self.shard_multiple(ctx, None) <= 1:
+            return False
+        from ..parallel.partitioner import get_partitioner
+
+        if get_partitioner().demote(f"{type(cause).__name__}: {cause}"):
+            rl.record_shard_demotion(self.report, kind, cause)
+        return True
+
 
 def _use_pallas() -> bool:
     env = config.get_raw("RACON_TPU_PALLAS")
@@ -618,43 +648,67 @@ def _build_kernel(cfg, B, use_pallas, kind: str = "v2"):
     # knob mid-process (hw_session's compressed-vs-flat steps) must not
     # serve a kernel built under the other loop shape.
     colstep = config.get_bool("RACON_TPU_POA_COLSTEP")
-    # Same build-observability pattern as kernel_cache.device_keyed_cache:
-    # a miss is only known after the call, so the span is retroactive.
-    misses0 = _build_kernel_cached.cache_info().misses
-    t0 = time.monotonic_ns()
-    built = _build_kernel_cached(cfg, B, use_pallas, kind, _n_devices(),
-                                 _platform(), colstep)
-    if _build_kernel_cached.cache_info().misses != misses0:
-        from . import cost_hooks
+    # Shard count resolved here (not in the cached builder) so the key
+    # is explicit: a will_shard flip — knob, demotion, mesh change —
+    # can never serve a kernel wrapped for the wrong dispatch mode.
+    shard_n = _shard_n(B)
+    if shard_n > 1 and B % shard_n:
+        shard_n = 1  # geometry was sized for a different mesh; stay local
+    for m in ((shard_n, 1) if shard_n > 1 else (1,)):
+        # Same build-observability pattern as
+        # kernel_cache.device_keyed_cache: a miss is only known after
+        # the call, so the span is retroactive.
+        misses0 = _build_kernel_cached.cache_info().misses
+        t0 = time.monotonic_ns()
+        try:
+            built = _build_kernel_cached(cfg, B, use_pallas, kind,
+                                         _n_devices(), _platform(),
+                                         colstep, m)
+        except Exception as e:  # noqa: BLE001 — shard lattice edge
+            if m <= 1:
+                raise
+            # sharded build failed: drop the partitioner to
+            # single-device for the rest of the process and rebuild the
+            # SAME tier locally (never a tier demotion, never fatal)
+            from ..parallel.partitioner import get_partitioner
 
-        # predicted per-window bill for this geometry/tier, stamped next
-        # to the measured build wall (obs/costmodel.py)
-        pred = cost_hooks.record_build(
-            "build_lockstep_poa_kernel" if kind == "ls"
-            else "build_pallas_poa_kernel" if kind == "v2"
-            else "build_poa_kernel", (cfg,), {})
-        obs.add_complete("kernel.build", t0, time.monotonic_ns(),
-                         builder=f"poa.{kind}", B=B,
-                         max_nodes=cfg.max_nodes, depth=cfg.depth, **pred)
-        obs.count(f"kernel.builds.poa.{kind}")
-    return built
+            if get_partitioner().demote(f"{type(e).__name__}: {e}"):
+                rl.record_shard_demotion(None, kind, e)
+            continue
+        if _build_kernel_cached.cache_info().misses != misses0:
+            from . import cost_hooks
+
+            # predicted per-window bill for this geometry/tier, stamped
+            # next to the measured build wall (obs/costmodel.py)
+            pred = cost_hooks.record_build(
+                "build_lockstep_poa_kernel" if kind == "ls"
+                else "build_pallas_poa_kernel" if kind == "v2"
+                else "build_poa_kernel", (cfg,), {})
+            obs.add_complete("kernel.build", t0, time.monotonic_ns(),
+                             builder=f"poa.{kind}", B=B, shards=m,
+                             max_nodes=cfg.max_nodes, depth=cfg.depth,
+                             **pred)
+            obs.count(f"kernel.builds.poa.{kind}")
+        return built
 
 
 @functools.lru_cache(maxsize=64)
 def _build_kernel_cached(cfg, B, use_pallas, kind, n_dev, platform,
-                         colstep=True):
+                         colstep=True, shard_n=1):
     """Single- or multi-device kernel for a B-window batch.
 
-    Multi-device: batch dim sharded over the 1-D `windows` mesh — the
-    production analogue of the reference's multi-GPU batch striping
-    (src/cuda/cudapolisher.cpp:228-240), with no collectives.
+    shard_n > 1: batch dim sharded over the partitioner's mesh (the
+    production analogue of the reference's multi-GPU batch striping,
+    src/cuda/cudapolisher.cpp:228-240, with no collectives) — shard_map
+    around the per-shard pallas build, pjit sharding constraints around
+    the XLA twin (which partitions transparently).
 
     Memoized on the full geometry key — including the device topology
-    (n_dev, platform): the warm-up's compiled kernel IS the measured
-    run's function object, so the in-process jit cache hits even when the
-    persistent disk cache can't serve (observed: AOT entries compiled
-    under different machine features fail to load and silently recompile
-    — minutes per geometry on the CPU twin).
+    (n_dev, platform) and the shard count: the warm-up's compiled kernel
+    IS the measured run's function object, so the in-process jit cache
+    hits even when the persistent disk cache can't serve (observed: AOT
+    entries compiled under different machine features fail to load and
+    silently recompile — minutes per geometry on the CPU twin).
     """
     assert not (use_pallas and not _fits_vmem(cfg, kind)), (
         "caller must check _fits_vmem before requesting the pallas kernel")
@@ -664,19 +718,20 @@ def _build_kernel_cached(cfg, B, use_pallas, kind, n_dev, platform,
         else:
             from .poa_pallas import build_pallas_poa_kernel as build
         interp = platform != "tpu"
-        if n_dev == 1:
+        if shard_n <= 1:
             return build(cfg, interpret=interp, colstep=colstep)(B)
-        from ..parallel.mesh import shard_batch_build
-        sharded = shard_batch_build(
+        from ..parallel.partitioner import get_partitioner
+        sharded = get_partitioner().shard_build(
             lambda b: build(cfg, interpret=interp, colstep=colstep)(b),
             B, 9, 5)
-        assert sharded is not None, (B, n_dev)  # _device_batch divides B
+        assert sharded is not None, (B, shard_n)  # _device_batch divides B
         return sharded
     kernel = poa.build_poa_kernel(cfg)
-    if n_dev == 1:
+    if shard_n <= 1:
         return kernel
-    from ..parallel.mesh import device_mesh, shard_batch_kernel
-    return shard_batch_kernel(kernel, device_mesh(), 9)
+    from ..parallel.partitioner import get_partitioner
+    return get_partitioner().partition(
+        kernel, in_axes=[("windows",)] * 9, out_axes=("windows",))
 
 
 def _export_chunk(pipeline, idxs, cfg, fallback, stats=None, report=None):

@@ -19,14 +19,29 @@ AXIS = "windows"
 
 # jax >= 0.7 promotes shard_map to the public namespace and renames the
 # replication-check kwarg check_rep -> check_vma; 0.4.x only has the
-# experimental spelling.  Resolve once at import so shard_batch_build
-# works on both.
-try:
-    _shard_map = jax.shard_map
-    _NO_CHECK = {"check_vma": False}
-except AttributeError:
-    from jax.experimental.shard_map import shard_map as _shard_map
-    _NO_CHECK = {"check_rep": False}
+# experimental spelling.
+
+
+def resolve_shard_map(jax_mod=None):
+    """(shard_map callable, replication-check-off kwargs) for this jax.
+
+    The version shim, factored out so tests can drive BOTH branches with
+    stand-in modules (a jax bump that moves/renames shard_map again must
+    fail a test, not silently kill the sharded tier).  ``jax_mod``
+    defaults to the real ``jax``."""
+    mod = jax if jax_mod is None else jax_mod
+    fn = getattr(mod, "shard_map", None)
+    if fn is not None:
+        return fn, {"check_vma": False}
+    sub = getattr(mod.experimental, "shard_map", None)
+    if sub is None:
+        import importlib
+        sub = importlib.import_module(
+            mod.__name__ + ".experimental.shard_map")
+    return sub.shard_map, {"check_rep": False}
+
+
+_shard_map, _NO_CHECK = resolve_shard_map()
 
 
 def device_mesh(devices: Optional[Sequence] = None) -> Mesh:
@@ -66,6 +81,8 @@ def shard_batch_build(build_local, batch, n_in, n_out):
 
 def divisible_batch(n_devices: int, b: int) -> int:
     """Largest batch size <= max(b, n_devices) that divides evenly over the
-    mesh (the consensus driver rounds DOWN so per-device memory stays within
-    the configured budget)."""
+    mesh.  LEGACY round-DOWN: remainder windows spilled to the slow path.
+    The drivers now round UP via ``partitioner.Partitioner.pad_rows`` and
+    count the padding in stats; kept for callers that need the old
+    semantics (and for the regression test pinning the difference)."""
     return max(1, b // n_devices) * n_devices

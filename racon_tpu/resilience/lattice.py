@@ -184,3 +184,22 @@ def next_consensus_tier(kind: str) -> str:
     """The tier below `kind` in the consensus lattice ('host' floor)."""
     i = CONSENSUS_TIERS.index(kind)
     return CONSENSUS_TIERS[min(i + 1, len(CONSENSUS_TIERS) - 1)]
+
+
+def record_shard_demotion(report, tier: str, cause) -> None:
+    """The `sharded -> single-device` lattice edge, recorded once.
+
+    Orthogonal to tier demotion: the kernel stays at `tier`, only the
+    mesh dispatch is dropped (sharding changes where rows compute, never
+    what — output stays byte-identical).  Shows up in the report's
+    degradation list as `<tier>+sharded -> <tier>` and in the metrics as
+    `shard.demotions`, so a silent fallback to one device is visible in
+    any trace or run report."""
+    exc = cause if isinstance(cause, BaseException) else None
+    if report is not None:
+        report.record_degrade(f"{tier}+sharded", tier, exc)
+    obs.count("shard.demotions")
+    import sys
+    print(f"[racon-tpu] sharded dispatch failed at tier {tier!r} "
+          f"({cause}); demoting to single-device dispatch",
+          file=sys.stderr)

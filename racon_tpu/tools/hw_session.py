@@ -51,6 +51,9 @@ Priorities (unchanged):
   8. aligner_host — RACON_TPU_DEVICE_ALIGNER=host bench
   9. jobs2        — wrapper --split --jobs 2 --tpu multi-process rehearsal
  10. factor4      — bench with RACON_TPU_NODE_FACTOR=4
+ 11. multichip    — 1/2/4/8-device scaling sweep + sharded dryrun gate
+                    on the real backend (tools/multichip.py; rewrites
+                    MULTICHIP_r06.json with the silicon curve)
 
 Usage:
     python racon_tpu/tools/hw_session.py                # full session
@@ -139,6 +142,14 @@ STEPS = [
         "assert r.returncode == 0\n")], 3600, {}),
     ("factor4", [sys.executable, "bench.py"], 2700,
      {"RACON_TPU_NODE_FACTOR": "4"}),
+    # device-count scaling sweep on the REAL backend (mesh widths 1/2/4/8
+    # by under-subscription) + the sharded byte-identity dryrun gate;
+    # overwrites the committed forced-CPU MULTICHIP_r06.json with the
+    # silicon curve — the one number ROADMAP item 2's near-linear-scaling
+    # criterion needs (checkpointed like every step: a wedge mid-sweep
+    # resumes here next session)
+    ("multichip", [sys.executable, "racon_tpu/tools/multichip.py",
+                   "--real", "--out", "MULTICHIP_r06.json"], 3600, {}),
 ]
 
 

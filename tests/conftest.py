@@ -75,6 +75,15 @@ def _clear_kernel_cache():
         poa_driver._build_kernel_cached.cache_clear()
     except Exception:  # noqa: BLE001 — package may not be importable yet
         pass
+    try:
+        # the memoized Partitioner carries sticky sharded->single-device
+        # demotion state; a test that trips it must not demote the rest
+        # of the suite
+        from racon_tpu.parallel import reset_partitioner
+
+        reset_partitioner()
+    except Exception:  # noqa: BLE001
+        pass
 
 
 _COMP = bytes.maketrans(b"ACGT", b"TGCA")

@@ -29,3 +29,57 @@ def test_entry_compiles():
     out = jax.jit(fn)(*args)
     cons_len = np.asarray(out[2])
     assert (cons_len > 0).all()
+
+
+def test_multichip_sweep_measures_in_process():
+    """The sweep's worker body (tools/multichip.measure) on the ambient
+    8-device mesh: sharded geometry, positive throughput, balanced
+    per-device row counters."""
+    import os
+
+    from racon_tpu.tools import multichip as mc
+
+    os.environ["RACON_TPU_BATCH_WINDOWS"] = "16"
+    try:
+        entry = mc.measure(8, repeats=1)
+    finally:
+        os.environ.pop("RACON_TPU_BATCH_WINDOWS", None)
+    assert entry["shards"] == 8 and entry["batch"] == 16
+    assert entry["rows_per_device"] == 2
+    assert entry["windows_per_s"] > 0
+    rows = [v for k, v in entry["counters"].items()
+            if k.startswith("shard.rows.d")]
+    assert len(rows) == 8 and max(rows) == min(rows)
+
+
+def test_bench_multichip_entry_normalizes_as_fixed_point():
+    """The multichip bench entry must round-trip normalize_entry
+    unchanged and form its own bench-history series (profile
+    multichip-*), like the serve and distrib lanes."""
+    import os
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    try:
+        from bench import normalize_entry
+    finally:
+        sys.path.remove(root)
+    from racon_tpu.obs import bench_track
+
+    entry = {
+        "metric": "multichip: sharded consensus windows/sec at 8 "
+                  "device(s) (counts [1, 2, 4, 8], tier xla, batch 64) "
+                  "[FORCED DRY-RUN: not device evidence]",
+        "value": 105.2, "unit": "windows/s", "vs_baseline": None,
+        "cost_model": None, "pack_split": None, "serial_steps": None,
+        "multichip": {"counts": {"1": {"windows_per_s": 95.1, "ok": True},
+                                 "8": {"windows_per_s": 105.2, "ok": True}},
+                      "scaling_vs_1": 1.106},
+        "forced": True,
+        "mbp": 0.5, "input": "paf", "profile": "multichip-ont",
+    }
+    assert normalize_entry(dict(entry)) == entry
+    plain = dict(entry, profile="ont")
+    assert (bench_track.series_key(entry)
+            != bench_track.series_key(plain))
