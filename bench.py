@@ -317,6 +317,24 @@ def serial_steps_stamp(cm) -> dict:
     return out or None
 
 
+def band_stamp(snap):
+    """Banded-DP evidence from the measured run's counter snapshot:
+    per-phase banded cell totals and the verify-and-widen hit rate
+    (``band.hits / band.jobs``), as a ``(cells_banded, band_hit_rate)``
+    pair.  Both None when banding never engaged — RACON_TPU_BAND off,
+    metrics disarmed, or no job narrow enough to band — which is a
+    different claim from a measured rate of 0.0 (banding on, every
+    band verified first try)."""
+    c = snap.get("counters") if isinstance(snap, dict) else None
+    jobs = (c or {}).get("band.jobs", 0)
+    if not jobs:
+        return None, None
+    cells = {ph: c[key] for ph, key in (("align", "align.cells.banded"),
+                                        ("poa", "poa.cells.banded"))
+             if c.get(key)}
+    return cells or None, round(c.get("band.hits", 0) / jobs, 4)
+
+
 def normalize_entry(e: dict) -> dict:
     """Reader-side honesty backfill for bench JSON entries/log lines.
 
@@ -356,6 +374,12 @@ def normalize_entry(e: dict) -> dict:
         # embedded cost-model stamp when it carried them, else explicit
         # null ("not predicted")
         e = dict(e, serial_steps=serial_steps_stamp(e.get("cost_model")))
+    if "cells_banded" not in e or "band_hit_rate" not in e:
+        # entries written before banded DP existed: explicit nulls ("not
+        # measured"), same semantics as a fresh run with banding off
+        e = dict(e)
+        e.setdefault("cells_banded", None)
+        e.setdefault("band_hit_rate", None)
     return e
 
 
@@ -379,6 +403,8 @@ def degraded_result(mbps_cpu: float, note: str = "") -> dict:
         "cost_model": None,
         "pack_split": None,
         "serial_steps": None,
+        "cells_banded": None,
+        "band_hit_rate": None,
     }
 
 
@@ -554,6 +580,7 @@ def main():
         snap_tpu, phase_wall(rep_tpu),
         config.get_str("RACON_TPU_MACHINE_PROFILE") or "auto",
         platform=platform)
+    cells_banded, band_hit_rate = band_stamp(snap_tpu)
     log_device_measurement({
         "mbp": MBP, "input": INPUT, "profile": PROFILE,
         "value": round(mbps_tpu, 4),
@@ -566,6 +593,7 @@ def main():
         "pack_split": pack_split(rep_tpu) or None,
         "cost_model": cm,
         "serial_steps": serial_steps_stamp(cm),
+        "cells_banded": cells_banded, "band_hit_rate": band_hit_rate,
         **({"sanitize": True} if sanitized else {}),
     })
     print(json.dumps({
@@ -578,6 +606,7 @@ def main():
         "pack_split": pack_split(rep_tpu) or None,
         "cost_model": cm,
         "serial_steps": serial_steps_stamp(cm),
+        "cells_banded": cells_banded, "band_hit_rate": band_hit_rate,
         **({"sanitize": True} if sanitized else {}),
     }))
     print(f"[bench] tpu: {bp_tpu} bp in {dt_tpu:.1f}s | "
@@ -669,6 +698,8 @@ def serve_profile(jobs: int = 4, clients: int = 2) -> int:
         "cost_model": None,
         "pack_split": None,
         "serial_steps": None,
+        "cells_banded": None,
+        "band_hit_rate": None,
         "serve": serve_stats,
         **({"device_status": "unreachable"} if degraded else {}),
     }
@@ -748,6 +779,8 @@ def distrib_profile(workers: int = 3) -> int:
         "cost_model": None,
         "pack_split": None,
         "serial_steps": None,
+        "cells_banded": None,
+        "band_hit_rate": None,
         "distrib": distrib_stats,
     }
     assert normalize_entry(dict(entry)) == entry, \
@@ -816,6 +849,8 @@ def multichip_profile(counts=(1, 2, 4, 8), repeats: int = 3) -> int:
         "cost_model": None,
         "pack_split": None,
         "serial_steps": None,
+        "cells_banded": None,
+        "band_hit_rate": None,
         "multichip": mc_stats,
         **({"forced": True} if not real else {}),
     }

@@ -64,6 +64,18 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
        "packed Hirschberg DP: 4 query bases per word, 4 DP rows per "
        "serial loop iteration (0 restores one-row-per-step kernels; "
        "output is byte-identical either way)"),
+    _k("RACON_TPU_BAND", "0", "bool",
+       "banded DP on the hot kernels: Ukkonen-banded Hirschberg "
+       "alignment + diagonal-banded POA with verify-and-widen "
+       "re-dispatch, falling back to the flat kernels on band-hit "
+       "exhaustion (output is byte-identical either way)"),
+    _k("RACON_TPU_BAND_SLACK", "32", "int",
+       "banded DP initial half-band slack: first band width is the "
+       "query/target length delta plus this many diagonals before "
+       "bucketing"),
+    _k("RACON_TPU_BAND_MAX_WIDENINGS", "2", "int",
+       "banded DP widening budget: band-hit jobs double their band this "
+       "many times before taking the banded->flat lattice edge"),
     _k("RACON_TPU_BATCH_WINDOWS", None, "int",
        "windows per device batch (default: 64 on TPU, 4 elsewhere)"),
     _k("RACON_TPU_PIPELINE_DEPTH", "2", "int",

@@ -75,6 +75,14 @@ POA_COLSTEP_PACK = 2.0
 #: DP rows, dividing the row-scan trip count.
 ALIGN_ROW_PACK = 4.0
 
+#: ops.band.BAND_BUCKETS — the verify-and-widen ladder's compiled band
+#: rungs (RACON_TPU_BAND); the top rungs coincide with the flat
+#: aligner's BANDS, so the ladder's ceiling is the flat kernel.
+BAND_BUCKETS = (128, 256, 512, 1024, 2048)
+#: config default for RACON_TPU_BAND_SLACK — the half-band margin added
+#: to the length delta when planning w0.
+BAND_SLACK = 32
+
 #: Vector ops per DP cell (sub/ins/del merge, weight add, move select,
 #: cummax contribution) — same math in all three tiers.
 POA_FLOPS_PER_CELL = 14.0
@@ -246,6 +254,50 @@ def align_job_cost(cap: int, band: int, tier: str = "xla") -> CostEstimate:
         steps = 3.0 * cap          # row scan + traceback chain
         hbm = cells * ALIGN_BYTES_PER_CELL
     return CostEstimate(cells * ALIGN_FLOPS_PER_CELL, hbm, steps)
+
+
+def banded_align_job_cost(cap: int, k: int) -> CostEstimate:
+    """Predicted work for ONE Hirschberg job served on band rung `k`
+    (RACON_TPU_BAND): the fwd+bwd distance passes iterate ``2*cap*k``
+    cells instead of ``2*cap*band_for(cap)`` — the in-loop cell bill
+    divides by the band ratio.  The serial row scan is UNCHANGED: the
+    band narrows each row's live lanes, it does not shorten the
+    latency chain (same rows, fewer columns per row)."""
+    cells = 2.0 * float(cap) * k
+    steps = 4.0 * cap / ALIGN_ROW_PACK
+    return CostEstimate(cells * ALIGN_FLOPS_PER_CELL, cap * 2.0, steps)
+
+
+def banded_poa_window_cost(depth: int, wl_class: int, w: int,
+                           tier: str) -> CostEstimate:
+    """Predicted work for ONE banded POA window at runtime half-band
+    `w` (wband): each rank's row keeps ``2*w + 1`` live columns around
+    its backbone offset instead of the full class width, so the cell
+    (and FLOP) bill scales by ``(2w+1)/wl_class``.  Rank-loop length —
+    the serial term — is unchanged; HBM traffic still streams every
+    admitted layer base once."""
+    ranks = NODE_GROWTH * wl_class
+    width = min(float(wl_class), 2.0 * w + 1.0)
+    cells = depth * ranks * width
+    flops = cells * POA_FLOPS_PER_CELL
+    hbm = depth * wl_class * POA_LAYER_BYTES + 2 * wl_class * 5
+    steps = depth * ranks
+    if tier in ("v2", "ls"):
+        steps /= POA_COLSTEP_PACK
+    if tier == "ls":
+        steps /= LS_GROUP
+    return CostEstimate(flops, hbm, steps)
+
+
+def banded_cell_ratio(kind: str, *, cap: int = 0, band: int = 0, k: int = 0,
+                      wl_class: int = 0, w: int = 0) -> float:
+    """Predicted flat/banded in-loop cell ratio for one unit — the
+    quantity dp_cost_probe's ``--gate`` measures on silicon and
+    docs/benchmarks.md tabulates.  kind 'align': flat band `band` vs
+    rung `k`; kind 'poa': class width `wl_class` vs half-band `w`."""
+    if kind == "align":
+        return float(band) / max(1, k)
+    return float(wl_class) / max(1.0, min(float(wl_class), 2.0 * w + 1.0))
 
 
 def roofline(est: CostEstimate, prof: MachineProfile):
@@ -522,6 +574,22 @@ def predict_from_counters(counters: Dict[str, int],
         buckets.append({"kind": "align", "tier": "hirschberg",
                         "cells": float(hs_cells), "predicted_s": sec,
                         "verdict": verdict})
+    # banded-DP info rows (RACON_TPU_BAND): the actually-iterated cells
+    # of banded jobs/windows.  Informational only — the flat-equivalent
+    # bill is already inside the hirschberg / poa bucket estimates
+    # above, so these are NOT added to the phase totals (no double
+    # count); the flat-vs-banded cell ratio is the measured saving.
+    for phase, cname, fpc in (
+            ("align", "align.cells.banded", ALIGN_FLOPS_PER_CELL),
+            ("poa", "poa.cells.banded", POA_FLOPS_PER_CELL)):
+        bnd = counters.get(cname, 0)
+        if bnd:
+            best = _over_devices(
+                CostEstimate(bnd * fpc, bnd * 0.1, 0.0), n_devices)
+            sec, verdict = roofline(best, prof)
+            buckets.append({"kind": "banded", "phase": phase,
+                            "tier": "banded", "cells": float(bnd),
+                            "predicted_s": sec, "verdict": verdict})
     align_s, align_verdict = roofline(a_est, prof)
     # the host aligner serves whatever the device buckets did not cover
     total_cells = counters.get("align.cells.total", 0)
@@ -795,6 +863,9 @@ def render_validation(v: dict) -> str:
             if b["kind"] == "poa":
                 name = f"poa d{b['depth']} c{b['class']}"
                 extra = f" x{b['windows']}" if b.get("windows") else ""
+            elif b["kind"] == "banded":
+                name = f"banded {b['phase']}"
+                extra = ""
             else:
                 name = f"align {b['tier']}" + (
                     f" c{b['cap']}" if b.get("cap") else "")

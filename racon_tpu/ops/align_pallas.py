@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import config
+from . import band as _band
 from .encoding import encode, pack_bases
 from .kernel_cache import device_keyed_cache
 
@@ -308,7 +309,7 @@ def _build_base_kernel(K: int, interpret: bool = False, pack: int = 1):
         max(128, _round_up(RB // pack, 128))
 
     def kernel(scal_ref, q_ref, t_ref, ops_ref, cnt_ref, ok_ref,
-               MVS, tq_scr):
+               dist_ref, MVS, tq_scr):
         lane_k = jax.lax.broadcasted_iota(jnp.int32, (1, K), 1)
         lane_ops = jax.lax.broadcasted_iota(jnp.int32, (1, OPS), 1)
         R = scal_ref[0, 0, 0]
@@ -357,7 +358,7 @@ def _build_base_kernel(K: int, interpret: bool = False, pack: int = 1):
                 MVS[pl.ds(i - 1, 1), :] = mv
                 return nrow
 
-            jax.lax.fori_loop(1, R + 1, body, row0)
+            row_fin = jax.lax.fori_loop(1, R + 1, body, row0)
         else:
             def body(it, row):
                 qword = pltpu.roll(q_ref[0], jnp.mod(QW - it, QW),
@@ -374,7 +375,17 @@ def _build_base_kernel(K: int, interpret: bool = False, pack: int = 1):
                     row = jnp.where(i <= R, nrow, row)
                 return row
 
-            jax.lax.fori_loop(0, (R + pack - 1) // pack, body, row0)
+            row_fin = jax.lax.fori_loop(0, (R + pack - 1) // pack, body,
+                                        row0)
+
+        # terminal distance D = DP[R][S]: lane o with R + dmin + o == S
+        # (INF when the terminal cell is out of band).  Free with the
+        # final row already live — it is the banded mode's exact
+        # Ukkonen-verify input (ops/band.py) for base-case-only pairs.
+        o_fin = S - R - dmin
+        d_at = load_lane(row_fin, lane_k, jnp.clip(o_fin, 0, K - 1))
+        dist_ref[0, 0, 0] = jnp.where((o_fin >= 0) & (o_fin < K),
+                                      d_at, INF)
 
         # traceback from (R, S) to (0, 0); ops: 0=M 1=I(query) 2=D(target)
         def cond(c):
@@ -412,9 +423,10 @@ def _build_base_kernel(K: int, interpret: bool = False, pack: int = 1):
             kernel,
             grid=(batch,),
             in_specs=[smem3, vrow(QCAP), vrow(TCAP)],
-            out_specs=[vrow(OPS), smem1, smem1],
+            out_specs=[vrow(OPS), smem1, smem1, smem1],
             out_shape=[
                 jax.ShapeDtypeStruct((batch, 1, OPS), jnp.int32),
+                jax.ShapeDtypeStruct((batch, 1, 1), jnp.int32),
                 jax.ShapeDtypeStruct((batch, 1, 1), jnp.int32),
                 jax.ShapeDtypeStruct((batch, 1, 1), jnp.int32),
             ],
@@ -427,16 +439,17 @@ def _build_base_kernel(K: int, interpret: bool = False, pack: int = 1):
         call = make(b)
 
         def fn(scal, q, t):
-            ops, cnt, ok = call(scal.reshape(b, 1, 4),
-                                q.reshape(b, 1, QCAP),
-                                t.reshape(b, 1, TCAP))
-            return (ops.reshape(b, OPS), cnt.reshape(b), ok.reshape(b))
+            ops, cnt, ok, dist = call(scal.reshape(b, 1, 4),
+                                      q.reshape(b, 1, QCAP),
+                                      t.reshape(b, 1, TCAP))
+            return (ops.reshape(b, OPS), cnt.reshape(b), ok.reshape(b),
+                    dist.reshape(b))
 
         return fn
 
     @functools.lru_cache(maxsize=8)
     def jitted(batch):
-        sharded = _shard_over_mesh(plain, batch, 3, 3)
+        sharded = _shard_over_mesh(plain, batch, 3, 4)
         return sharded if sharded is not None else jax.jit(plain(batch))
 
     return jitted, OPS, QCAP, TCAP
@@ -458,24 +471,43 @@ def _interpret() -> bool:
     return _jax.devices()[0].platform != "tpu"
 
 
-def align_pairs(pairs, *, interpret=None):
+def align_pairs(pairs, *, interpret=None, band_overrides=None, hits=None):
     """pairs: [(q_codes int32 np, t_codes int32 np)] -> [ops np | None].
 
     ops are forward-ordered codes (0=M, 1=I, 2=D); None = host fallback
     (band escape / oversize).
+
+    band_overrides: {pair index: K} runs those pairs under the given
+    band (narrower than the flat ``band_for`` bucket) with the exact
+    Ukkonen in-band verify (ops/band.py): the terminal distance must
+    certify that every optimal AND co-optimal path lies strictly inside
+    the band — then midpoints, tie-breaks and traceback coincide with
+    the flat kernel's and the result is byte-identical.  A pair whose
+    certificate fails is aborted at its first round (no wasted
+    recursion), gets result None, and its index is added to `hits` for
+    the caller's verify-and-widen ladder.
     """
     if interpret is None:
         interpret = _interpret()
     results = [None] * len(pairs)
     segments = {}   # pair index -> list of (ia, ops array)
     bands = {}
+    verify = {}     # pair index -> (n, m, K, gdmin) for banded pairs
     active = []
     for idx, (q, t) in enumerate(pairs):
         n, m = len(q), len(t)
         K = band_for(n, m)
         if K == 0 or n == 0 or m == 0 or (n + 1) // 2 > ROW_BUCKETS[-1]:
             continue
-        bands[idx] = (K, np.minimum(0, m - n) - (K - 1 - abs(m - n)) // 2)
+        kb = band_overrides.get(idx) if band_overrides else None
+        if kb is not None and kb < K:
+            K = int(kb)
+        else:
+            kb = None
+        gdmin = int(np.minimum(0, m - n) - (K - 1 - abs(m - n)) // 2)
+        bands[idx] = (K, gdmin)
+        if kb is not None:
+            verify[idx] = (n, m, K, gdmin)
         segments[idx] = []
         active.append(_Task(idx, 0, n, 0, m))
 
@@ -486,12 +518,13 @@ def align_pairs(pairs, *, interpret=None):
         if not big:
             break
         active = [t for t in active if (t.ib - t.ia) <= BASE_ROWS]
-        new_tasks = _split_round(pairs, big, bands, failed, interpret)
+        new_tasks = _split_round(pairs, big, bands, failed, interpret,
+                                 verify)
         active.extend(new_tasks)
 
     # base cases
     base = [t for t in active if t.pair not in failed]
-    _solve_base(pairs, base, bands, segments, failed, interpret)
+    _solve_base(pairs, base, bands, segments, failed, interpret, verify)
 
     for idx, segs in segments.items():
         if idx in failed:
@@ -499,6 +532,10 @@ def align_pairs(pairs, *, interpret=None):
         segs.sort(key=lambda s: s[0])
         results[idx] = np.concatenate([s[1] for s in segs]) if segs else \
             np.zeros(0, np.int32)
+    if hits is not None and verify:
+        # any banded-pair failure is a band hit: a verified-clean banded
+        # pair cannot fail mid-recursion (certificate covers co-optima)
+        hits.update(idx for idx in failed if idx in verify)
     return results
 
 
@@ -544,7 +581,7 @@ def _task_arrays(pairs, tasks, bands, rcap, K, backward, pack=1):
     return scal, qs, ts
 
 
-def _split_round(pairs, tasks, bands, failed, interpret):
+def _split_round(pairs, tasks, bands, failed, interpret, verify=None):
     """One Hirschberg round: split every oversized task at its midpoint."""
     out = []
     by_bucket = {}
@@ -599,13 +636,25 @@ def _split_round(pairs, tasks, bands, failed, interpret):
             if tot[jstar] >= INF:
                 failed.add(t.pair)
                 continue
+            v = verify.get(t.pair) if verify else None
+            if (v is not None and t.ia == 0 and t.ib == v[0]
+                    and t.ja == 0 and t.jb == v[1]):
+                # root task of a banded pair: tot[jstar] IS the global
+                # edit distance (every path crosses the midpoint row),
+                # so check the exact Ukkonen certificate here and abort
+                # the whole pair before recursing on an unproven band
+                if not _band.ukkonen_ok(v[0], v[1], v[2], v[3],
+                                        int(tot[jstar])):
+                    failed.add(t.pair)
+                    continue
             jabs = t.ja + jstar
             out.append(_Task(t.pair, t.ia, imid, t.ja, jabs))
             out.append(_Task(t.pair, imid, t.ib, jabs, t.jb))
     return out
 
 
-def _solve_base(pairs, tasks, bands, segments, failed, interpret):
+def _solve_base(pairs, tasks, bands, segments, failed, interpret,
+                verify=None):
     by_bucket = {}
     for t in tasks:
         K = bands[t.pair][0]
@@ -637,9 +686,19 @@ def _solve_base(pairs, tasks, bands, segments, failed, interpret):
             else:
                 # QCAP == _round_up(BASE_ROWS, 128) == BASE_ROWS here
                 qs = qraw
-            ops, cnt, ok = (np.asarray(x)
-                            for x in kern(B)(scal, qs, ts))
+            ops, cnt, ok, dist = (np.asarray(x)
+                                  for x in kern(B)(scal, qs, ts))
             for bi, t in enumerate(chunk):
+                v = verify.get(t.pair) if verify else None
+                if (v is not None and t.ia == 0 and t.ib == v[0]
+                        and t.ja == 0 and t.jb == v[1]):
+                    # base-case-only banded pair: the kernel's terminal
+                    # distance carries the exact Ukkonen certificate
+                    if (not ok[bi]
+                            or not _band.ukkonen_ok(v[0], v[1], v[2],
+                                                    v[3], int(dist[bi]))):
+                        failed.add(t.pair)
+                        continue
                 if not ok[bi]:
                     failed.add(t.pair)
                     continue
@@ -680,6 +739,7 @@ class _HirschbergOps:
         self.stats = stats
         self.state = state        # {"served": int}
         self.pairs = {}           # job -> (q_view, t_view), packed once
+        self.band = {}            # job -> band.BandState (banded jobs)
         self.dead = False
 
     def live_tier(self, ctx, kind):
@@ -710,7 +770,30 @@ class _HirschbergOps:
         from ..resilience import faults
 
         faults.check("align.run", sub)
-        return align_pairs([self.pairs[j] for j in sub])
+        plist = [self.pairs[j] for j in sub]
+        overrides = {}
+        for bi, j in enumerate(sub):
+            st = self.band.get(j)
+            if st is not None and st.k is not None:
+                overrides[bi] = st.k
+        if not overrides:
+            return align_pairs(plist)
+        forced = False
+        try:
+            # the deterministic widening-exhaustion drill: an armed
+            # band.hit fault turns every banded job of this attempt
+            # into a hit, driving the ladder to its flat floor
+            faults.check("band.hit", sub)
+        except faults.InjectedFault:
+            forced = True
+        hits = set()
+        res = align_pairs(plist, band_overrides=overrides, hits=hits)
+        if forced:
+            hits.update(overrides)
+        # attempt stays pure (lattice retries/bisection re-call it);
+        # hit classification and ladder advance happen in install()
+        return [_band.HIT if bi in hits else res[bi]
+                for bi in range(len(sub))]
 
     def span_args(self, ctx, chunk, pipelined):
         return {"jobs": len(chunk)}
@@ -719,8 +802,21 @@ class _HirschbergOps:
         from ..resilience import faults
 
         for job, ops in zip(sub, results):
+            if isinstance(ops, _band.Hit):
+                # banded verify failed: advance this job's widening
+                # ladder; the executor's widen() loop re-attempts it
+                st = self.band.get(job)
+                if st is not None:
+                    n, m = self.dims[job]
+                    st.widen(n, m, band_for(n, m), self.report,
+                             tier=kind or "hirschberg",
+                             cells_counter="align.cells.banded")
+                continue
             if ops is None:
                 continue  # band escape: host aligns it
+            st = self.band.get(job)
+            if st is not None:
+                st.pending = False
             faults.check("align.install", (job,))
             self.pipeline.set_job_cigar(job, ops_to_cigar(ops))
             self.state["served"] += 1
@@ -747,10 +843,23 @@ class _HirschbergOps:
             self.report.record_degrade("hirschberg", "host", cause)
         return "host"
 
+    def widen(self, ctx, kind):
+        """Band-hit jobs of the current chunk awaiting a widened
+        re-attempt (executor verify-and-widen seam).  Clearing `pending`
+        here makes the ladder drain: a re-attempt either installs (flat
+        floor included — exhausted jobs re-run with no override) or hits
+        again, re-arming `pending` one rung higher."""
+        retry = [j for j in self.pairs
+                 if (st := self.band.get(j)) is not None and st.pending]
+        for j in retry:
+            self.band[j].pending = False
+        return retry
+
     def done(self, ctx, chunk):
         # keep host memory O(cohort): packed views die with the chunk
         for job in chunk:
             self.pairs.pop(job, None)
+            self.band.pop(job, None)
 
     # -- sharded dispatch (optional executor hook) -------------------------
     def demote_shard(self, ctx, kind, cause):
@@ -816,17 +925,30 @@ def run_jobs(pipeline, jobs, cohort: int = None, report=None,
             dims[job] = (len(qa), len(ta))
 
     # Length buckets: band x the first split round's row bucket — the
-    # geometry key align_pairs' rounds compile under.
+    # geometry key align_pairs' rounds compile under.  With banded DP on
+    # (RACON_TPU_BAND), a job whose Ukkonen band plan beats its flat
+    # bucket starts on the narrow band instead (verify-and-widen makes
+    # that safe), and the bucket key uses the banded K so cohorts stay
+    # geometry-homogeneous.
+    banded_on = _band.enabled()
+    band_states = {}
     buckets = {}
     for job in jobs:
         n, m = dims[job]
         K = band_for(n, m)
+        kb = _band.plan_align_band(n, m, K) if banded_on and K else None
+        if kb is not None:
+            band_states[job] = _band.BandState(kb)
         half = (max(n, 1) + 1) // 2
         rcap = next((rb for rb in ROW_BUCKETS if half <= rb), 0)
-        buckets.setdefault((K, rcap), []).append(job)
+        buckets.setdefault((kb if kb is not None else K, rcap),
+                           []).append(job)
+    if band_states:
+        obs.count("band.jobs", len(band_states))
 
     state = {"served": 0}
     ops_obj = _HirschbergOps(pipeline, dims, report, stats, state)
+    ops_obj.band = band_states
     executor = BatchExecutor(ops_obj, report=report)
     try:
         for (K, rcap), items in sorted(buckets.items()):
@@ -836,11 +958,19 @@ def run_jobs(pipeline, jobs, cohort: int = None, report=None,
                     # Measured-cell counter for the cost model
                     # (obs/costmodel.py): forward+backward distance
                     # passes over the recursion tree ~ 2x the base
-                    # max(n,m) x band DP.
+                    # max(n,m) x band DP.  align.cells.hirschberg stays
+                    # the flat-band count; align.cells.banded is what
+                    # the banded plan actually iterates, so the ratio of
+                    # the two is the measured cell cut.
                     obs.count("align.cells.hirschberg", sum(
                         2 * max(dims[j][0], dims[j][1])
                         * band_for(dims[j][0], dims[j][1])
                         for j in group))
+                    bj = [j for j in group if j in band_states]
+                    if bj:
+                        obs.count("align.cells.banded", sum(
+                            2 * max(dims[j][0], dims[j][1])
+                            * band_states[j].k for j in bj))
                 executor.submit(None, group)
         executor.flush()
     except Exception as e:  # noqa: BLE001 — lattice boundary

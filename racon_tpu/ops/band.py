@@ -1,0 +1,190 @@
+"""Banded-DP subsystem: Ukkonen band planning + the verify-and-widen
+ladder shared by all three hot DP kernels.
+
+The reference racon gets most of its aligner speed from edlib's
+score-bounded banded DP, and the window pipeline pre-localizes every
+read segment to its window — the optimal path hugs the backbone
+diagonal, so a band of width ``w₀ = |m - n| + slack`` contains it almost
+always.  This module owns the machinery that makes banding *safe*:
+
+* **band plan** — per-job initial band width from the length delta plus
+  the ``RACON_TPU_BAND_SLACK`` knob, bucketed to the kernels' lane
+  grids (``BAND_BUCKETS``);
+* **exact verify (aligner)** — for the unit-cost Hirschberg engine the
+  Ukkonen bound is exact: with the band placed symmetrically around the
+  main-diagonal corridor, any path that leaves the band costs at least
+  ``|m - n| + 2·(min_pad + 1)`` edits, so a banded terminal distance
+  ``D <= |m - n| + 2·min_pad`` proves every optimal AND co-optimal path
+  stays strictly inside the band — midpoints, tie-breaks and traceback
+  coincide with the flat kernel's, i.e. the banded CIGAR is
+  byte-identical (``ukkonen_ok``);
+* **composite hit signal (POA)** — sequence-to-graph scoring has no
+  clean unit-cost bound (graph jump edges move the diagonal for free),
+  so the banded POA kernels emit a conservative ``band_hit`` flag:
+  the optimum touched the band boundary, or the terminal score's
+  deficit exceeds the gap-cost bound for the band width (the kernels
+  compute it; ``poa_deficit_bound`` is the host-side mirror);
+* **widening ladder** — a hit job is re-dispatched at ``2w`` up to
+  ``RACON_TPU_BAND_MAX_WIDENINGS`` times, then falls back to the flat
+  kernel through the ``banded -> flat`` lattice edge
+  (``record_band_fallback``) — the flat kernel IS today's oracle, so
+  the ladder's floor never changes output.
+
+Counters (racon_tpu.obs): ``band.jobs`` (banded dispatches),
+``band.hits``, ``band.widenings``, ``band.fallbacks`` — bench.py derives
+``band_hit_rate`` from them and ``cells_banded`` from the
+``align.cells.banded`` / ``poa.cells.banded`` cell counters.
+"""
+
+from __future__ import annotations
+
+from .. import config, obs
+
+#: Band buckets the banded kernels compile under.  128 is the TPU lane
+#: width — the narrowest band a lane-parallel DP row can iterate — and
+#: the wider rungs coincide with the flat aligner's BANDS so the ladder
+#: tops out exactly where the flat kernel starts.
+BAND_BUCKETS = (128, 256, 512, 1024, 2048)
+
+
+class Hit:
+    """Sentinel result for a banded attempt whose verify failed: the
+    optimum touched the band boundary or the terminal score exceeded
+    the Ukkonen bound.  Flows through the lattice like any opaque
+    result; install() treats it as 'not served yet — widen'."""
+
+    __slots__ = ()
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return "<band.Hit>"
+
+
+HIT = Hit()
+
+
+def enabled() -> bool:
+    """Banded DP master switch (RACON_TPU_BAND, default off)."""
+    return config.get_bool("RACON_TPU_BAND")
+
+
+def slack() -> int:
+    """Extra half-band beyond the length delta (RACON_TPU_BAND_SLACK)."""
+    return max(0, config.get_int("RACON_TPU_BAND_SLACK"))
+
+
+def max_widenings() -> int:
+    """Bounded doublings before the flat fallback."""
+    return max(0, config.get_int("RACON_TPU_BAND_MAX_WIDENINGS"))
+
+
+def initial_width(n: int, m: int) -> int:
+    """w₀: the length delta plus the slack knob."""
+    return abs(m - n) + slack()
+
+
+def bucket_for(width: int):
+    """Smallest band bucket covering `width`; None = no bucket (flat)."""
+    for b in BAND_BUCKETS:
+        if width <= b:
+            return b
+    return None
+
+
+def plan_align_band(n: int, m: int, flat_k: int, widenings: int = 0):
+    """Banded K for an aligner job after `widenings` doublings, or None
+    when banding cannot beat the flat band (ladder exhausted, w₀ already
+    at or beyond the flat bucket, or job not device-eligible)."""
+    if flat_k <= 0 or flat_k <= BAND_BUCKETS[0]:
+        return None  # flat band is already minimal
+    k = bucket_for(initial_width(n, m) << widenings)
+    return k if k is not None and k < flat_k else None
+
+
+def ukkonen_ok(n: int, m: int, k: int, gdmin: int, dist) -> bool:
+    """Exact in-band certificate for the unit-cost aligner.
+
+    The band covers global diagonals ``[gdmin, gdmin + k - 1]``; the
+    optimal corridor spans diagonals ``[min(0, m-n), max(0, m-n)]``.
+    ``min_pad`` is the narrower margin between corridor and band edge.
+    A path using a diagonal outside the band costs at least
+    ``|m - n| + 2*(min_pad + 1)`` edits (each extra diagonal excursion
+    costs one insertion AND one deletion), so ``dist <= |m-n| +
+    2*min_pad`` proves strict in-band optimality — including every
+    co-optimal path, hence identical midpoint argmin tie-breaks and an
+    identical traceback vs the flat kernel."""
+    if dist is None:
+        return False
+    pad_low = min(0, m - n) - gdmin
+    pad_high = (gdmin + k - 1) - max(0, m - n)
+    min_pad = min(pad_low, pad_high)
+    if min_pad < 0:
+        return False  # band misplaced: corridor not covered
+    return dist <= abs(m - n) + 2 * min_pad
+
+
+def poa_deficit_bound(gap: int, w: int) -> int:
+    """Host-side mirror of the banded POA kernels' score-deficit bound:
+    a path that strays more than ``w`` columns off the backbone diagonal
+    pays at least ``2 * |gap| * (w // 2)`` in gap penalties over the
+    in-band alternative (the other half of the band absorbs legitimate
+    drift).  Terminal deficit above this => band_hit."""
+    return 2 * abs(gap) * max(1, w // 2)
+
+
+class BandState:
+    """Per-job ladder state threaded through an executor ops object."""
+
+    __slots__ = ("k", "widenings", "pending", "exhausted")
+
+    def __init__(self, k):
+        self.k = k                # current banded bucket; None = flat
+        self.widenings = 0
+        self.pending = False      # hit recorded, awaiting re-dispatch
+        self.exhausted = False    # fell back to the flat kernel
+
+    def widen(self, n: int, m: int, flat_k: int, report=None,
+              tier: str = "banded", cells_counter: str = None) -> None:
+        """Advance the ladder after a hit: double (bounded), else flat."""
+        obs.count("band.hits")
+        self.pending = True
+        if self.widenings < max_widenings():
+            self.widenings += 1
+            nxt = plan_align_band(n, m, flat_k, self.widenings)
+            if nxt is not None and nxt > self.k:
+                self.k = nxt
+                obs.count("band.widenings")
+                if cells_counter and obs.enabled():
+                    obs.count(cells_counter, 2 * max(n, m) * self.k)
+                return
+        # ladder exhausted (or next rung >= flat): the banded -> flat
+        # lattice edge
+        self.k = None
+        self.exhausted = True
+        record_band_fallback(report, tier)
+
+    def widen_width(self, cap: int, report=None,
+                    tier: str = "banded") -> None:
+        """POA flavor of `widen`: `k` is a runtime half-band width (not a
+        compiled bucket — the banded POA kernels take it as data), so the
+        ladder is a plain bounded doubling, with the flat kernel
+        (wband = 0 through the same compiled build) as the floor."""
+        obs.count("band.hits")
+        self.pending = True
+        if (self.widenings < max_widenings() and self.k
+                and 2 * self.k < cap):
+            self.widenings += 1
+            self.k *= 2
+            obs.count("band.widenings")
+            return
+        self.k = None
+        self.exhausted = True
+        record_band_fallback(report, tier)
+
+
+def record_band_fallback(report, tier: str, cause=None) -> None:
+    """The ``banded -> flat`` lattice edge (resilience/lattice.py owns
+    the canonical recorder; re-exported here so kernel-side callers
+    don't import the lattice)."""
+    from ..resilience.lattice import record_band_fallback as _rec
+
+    _rec(report, tier, cause)

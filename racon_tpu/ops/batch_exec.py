@@ -47,6 +47,13 @@ The driver supplies an *ops* object (duck-typed; no registration):
     demote(ctx, kind, cause)  # tier died: record + return next tier
     done(ctx, chunk)          # optional: chunk fully resolved — release
                               # any per-chunk packed state
+    widen(ctx, kind)          # optional (banded DP): items of the chunk
+                              # whose band verify failed and that should
+                              # be re-attempted with widened params
+                              # ([] = ladder drained).  The executor
+                              # loops attempt+install over them reusing
+                              # the packed batch — the verify-and-widen
+                              # re-dispatch seam (ops/band.py)
 
 Sharded dispatch (optional hooks; engines without them are untouched):
 
@@ -234,8 +241,46 @@ class BatchExecutor:
                 ops.install(ctx, kind, sub, results)
             for item, exc in quarantined:
                 ops.quarantine(ctx, item, exc)
+            self._widen(ctx, kind, attempt)
             self._done(ctx, chunk)
             return
+
+    def _widen(self, ctx, kind, attempt) -> None:
+        """Drain the ops' verify-and-widen ladder (banded DP): re-serve
+        the chunk's band-hit items with widened params until the ladder
+        is empty.  Re-dispatches reuse the packed batch views (install
+        advanced each item's band state; attempt reads it), so a retry
+        costs zero re-packing.  The ladder is bounded
+        (RACON_TPU_BAND_MAX_WIDENINGS doublings, then the flat kernel),
+        so this loop terminates."""
+        ops = self.ops
+        widen = getattr(ops, "widen", None)
+        if widen is None:
+            return
+        while True:
+            retry = widen(ctx, kind)
+            if not retry:
+                return
+            t0 = time.monotonic_ns()
+            try:
+                with obs.span(ops.span_name, tier=kind,
+                              band_retry=len(retry)):
+                    pairs, quarantined = rl.serve_with_bisect(
+                        retry, attempt, tier=kind, report=self.report,
+                        cached=None)
+            except rl.TierDead as td:
+                self.kernel_ns += time.monotonic_ns() - t0
+                # the tier died mid-ladder: surrender the pending
+                # band retries to the host floor (the oracle) rather
+                # than re-serving the already-installed chunk
+                ops.demote(ctx, kind, td.cause)
+                ops.surrender(ctx, retry, exported=True)
+                return
+            self.kernel_ns += time.monotonic_ns() - t0
+            for sub, results in pairs:
+                ops.install(ctx, kind, sub, results)
+            for item, exc in quarantined:
+                ops.quarantine(ctx, item, exc)
 
     def _done(self, ctx, chunk) -> None:
         done = getattr(self.ops, "done", None)

@@ -31,6 +31,7 @@ from ..resilience import faults
 from ..resilience import lattice as rl
 from ..resilience.journal import replay_windows
 from ..resilience.report import PhaseReport
+from . import band as _band
 from . import poa
 from .batch_exec import BatchExecutor, pipeline_depth as _pipeline_depth
 from .encoding import decode, encode
@@ -85,6 +86,25 @@ def _kernel_kind() -> str:
         raise ValueError(
             f"RACON_TPU_POA_KERNEL must be 'ls' or 'v2', got {k!r}")
     return k
+
+
+def _band_active(kind: str) -> bool:
+    """Banded POA dispatch: RACON_TPU_BAND on and a Pallas tier serving
+    (the XLA twin and the host floor always run flat — they are the
+    byte-identity oracles the verify-and-widen ladder bottoms out on)."""
+    return kind in _PALLAS_KINDS and _band.enabled()
+
+
+def _initial_poa_band(wx, keep, cfg):
+    """w₀ (half-band) for a window: the worst admitted layer's
+    length-vs-span delta plus the slack knob; None (flat) when the band
+    would not be meaningfully narrower than the full DP row."""
+    if not keep:
+        return None
+    delta = max(abs(int(wx.lens[j]) - (int(wx.ends[j]) - int(wx.begins[j])))
+                for j in keep)
+    w0 = delta + _band.slack()
+    return w0 if 2 * w0 + 1 < cfg.max_len // 2 else None
 
 
 def _shard_n(B: int) -> int:
@@ -407,9 +427,10 @@ def warm_geometries(window_lengths, match: int, mismatch: int,
                     break
                 try:
                     faults.check(f"poa.run.{kind}", ())
-                    _unpack(_submit(kernel, _pack([], cfg, B),
-                                    kind in _PALLAS_KINDS),
-                            kind in _PALLAS_KINDS)
+                    pallas = kind in _PALLAS_KINDS
+                    banded = _band_active(kind)
+                    _unpack(_submit(kernel, _pack([], cfg, B), pallas,
+                                    banded), pallas, banded)
                     break
                 except Exception as e:  # noqa: BLE001 — same degrade
                     # philosophy as run_consensus_phase: a Mosaic failure
@@ -510,6 +531,30 @@ class _ConsensusOps:
         self.report = report
         self.journal = journal
         self.dead_geoms = dead_geoms
+        # verify-and-widen ladder state (ops/band.py): window idx ->
+        # BandState; _band_retry holds hit windows awaiting the
+        # executor's widen loop
+        self.band = {}
+        self._band_retry = []
+
+    def _widths(self, chunk, cfg):
+        """Per-window half-band widths for _pack (0 = flat), creating
+        ladder state on first touch."""
+        if not _band.enabled():
+            return None
+        widths = {}
+        for i, wx, keep in chunk:
+            st = self.band.get(i)
+            if st is None:
+                st = _band.BandState(_initial_poa_band(wx, keep, cfg))
+                self.band[i] = st
+                if st.k:
+                    obs.count("band.jobs")
+                    if obs.enabled():
+                        obs.count("poa.cells.banded",
+                                  len(keep) * (2 * st.k + 1))
+            widths[i] = st.k or 0
+        return widths
 
     def live_tier(self, ctx, kind):
         # best LIVE tier for this geometry (earlier chunks or the warm-up
@@ -527,27 +572,50 @@ class _ConsensusOps:
         # Always pad to B: a dataset-size-dependent final-chunk shape
         # would force an extra jit compile per distinct remainder (padded
         # windows are 1-base/0-layer — free).
-        return _pack(chunk, ctx.cfg, self.B)
+        return _pack(chunk, ctx.cfg, self.B, self._widths(chunk, ctx.cfg))
 
     def dispatch(self, ctx, kind, packed, chunk):
         faults.check(f"poa.run.{kind}", [i for i, _, _ in chunk])
-        return _submit(ctx.kernel, packed, kind in _PALLAS_KINDS)
+        return _submit(ctx.kernel, packed, kind in _PALLAS_KINDS,
+                       _band_active(kind))
 
     def attempt(self, ctx, kind, sub):
         pallas = kind in _PALLAS_KINDS
+        banded = _band_active(kind)
         faults.check(f"poa.run.{kind}", [i for i, _, _ in sub])
-        return _unpack(_submit(ctx.kernel, _pack(sub, ctx.cfg, self.B),
-                               pallas), pallas)
+        return _unpack(
+            _submit(ctx.kernel,
+                    _pack(sub, ctx.cfg, self.B, self._widths(sub, ctx.cfg)),
+                    pallas, banded), pallas, banded)
 
     def unpack(self, ctx, kind, outs):
-        return _unpack(outs, kind in _PALLAS_KINDS)
+        return _unpack(outs, kind in _PALLAS_KINDS, _band_active(kind))
 
     def span_args(self, ctx, chunk, pipelined):
         return {"windows": len(chunk), "pipelined": pipelined}
 
     def install(self, ctx, kind, sub, results):
-        _install(self.pipeline, sub, results, self.trim, self.stats,
-                 self.fallback, self.report, kind, self.journal)
+        forced = False
+        if _band_active(kind):
+            # the widening-exhaustion drill: an armed band.hit fault
+            # classifies every banded window as a hit instead of raising,
+            # driving the ladder deterministically to its flat floor
+            try:
+                faults.check("band.hit", [i for i, _, _ in sub])
+            except faults.InjectedFault:
+                forced = True
+        retry = _install(self.pipeline, sub, results, self.trim, self.stats,
+                         self.fallback, self.report, kind, self.journal,
+                         band_states=self.band,
+                         band_cap=ctx.cfg.max_len // 2, force_hit=forced)
+        if retry:
+            self._band_retry.extend(retry)
+
+    def widen(self, ctx, kind):
+        # executor widen hook: hit windows re-dispatched at their widened
+        # (or flat, wband=0) band through the same tier
+        retry, self._band_retry = self._band_retry, []
+        return retry
 
     def surrender(self, ctx, items, exported):
         if exported:
@@ -648,6 +716,10 @@ def _build_kernel(cfg, B, use_pallas, kind: str = "v2"):
     # knob mid-process (hw_session's compressed-vs-flat steps) must not
     # serve a kernel built under the other loop shape.
     colstep = config.get_bool("RACON_TPU_POA_COLSTEP")
+    # Banded builds ride the cache key too: the flat and banded variants
+    # of a geometry are distinct compiled kernels (extra wband input /
+    # band_hit output), and the flat one is the ladder's oracle.
+    banded = use_pallas and _band_active(kind)
     # Shard count resolved here (not in the cached builder) so the key
     # is explicit: a will_shard flip — knob, demotion, mesh change —
     # can never serve a kernel wrapped for the wrong dispatch mode.
@@ -663,7 +735,7 @@ def _build_kernel(cfg, B, use_pallas, kind: str = "v2"):
         try:
             built = _build_kernel_cached(cfg, B, use_pallas, kind,
                                          _n_devices(), _platform(),
-                                         colstep, m)
+                                         colstep, m, banded)
         except Exception as e:  # noqa: BLE001 — shard lattice edge
             if m <= 1:
                 raise
@@ -694,7 +766,7 @@ def _build_kernel(cfg, B, use_pallas, kind: str = "v2"):
 
 @functools.lru_cache(maxsize=64)
 def _build_kernel_cached(cfg, B, use_pallas, kind, n_dev, platform,
-                         colstep=True, shard_n=1):
+                         colstep=True, shard_n=1, banded=False):
     """Single- or multi-device kernel for a B-window batch.
 
     shard_n > 1: batch dim sharded over the partitioner's mesh (the
@@ -719,11 +791,14 @@ def _build_kernel_cached(cfg, B, use_pallas, kind, n_dev, platform,
             from .poa_pallas import build_pallas_poa_kernel as build
         interp = platform != "tpu"
         if shard_n <= 1:
-            return build(cfg, interpret=interp, colstep=colstep)(B)
+            return build(cfg, interpret=interp, colstep=colstep,
+                         band=banded)(B)
         from ..parallel.partitioner import get_partitioner
+        n_in, n_out = (10, 6) if banded else (9, 5)
         sharded = get_partitioner().shard_build(
-            lambda b: build(cfg, interpret=interp, colstep=colstep)(b),
-            B, 9, 5)
+            lambda b: build(cfg, interpret=interp, colstep=colstep,
+                            band=banded)(b),
+            B, n_in, n_out)
         assert sharded is not None, (B, shard_n)  # _device_batch divides B
         return sharded
     kernel = poa.build_poa_kernel(cfg)
@@ -770,7 +845,7 @@ def _export_chunk(pipeline, idxs, cfg, fallback, stats=None, report=None):
     return chunk
 
 
-def _pack(chunk, cfg, pad_to=None):
+def _pack(chunk, cfg, pad_to=None, band_widths=None):
     B = pad_to if pad_to is not None else len(chunk)
     bb = np.zeros((B, cfg.max_backbone), dtype=np.uint8)
     bbw = np.zeros((B, cfg.max_backbone), dtype=np.int32)
@@ -781,8 +856,11 @@ def _pack(chunk, cfg, pad_to=None):
     lens = np.zeros((B, cfg.depth), dtype=np.int32)
     begins = np.zeros((B, cfg.depth), dtype=np.int32)
     ends = np.zeros((B, cfg.depth), dtype=np.int32)
+    wband = np.zeros(B, dtype=np.int32)   # 0 = flat (padded rows stay 0)
 
     for bi, (i, wx, keep) in enumerate(chunk):
+        if band_widths:
+            wband[bi] = band_widths.get(i, 0)
         L = len(wx.backbone)
         bb[bi, :L] = encode(wx.backbone)
         bbw[bi, :L] = wx.backbone_weights
@@ -814,20 +892,25 @@ def _pack(chunk, cfg, pad_to=None):
         lens[bi, :K] = lens_k
         begins[bi, :K] = wx.begins[kp]
         ends[bi, :K] = wx.ends[kp]
-    return (bb, bbw, bb_len, n_layers, seqs, ws, lens, begins, ends)
+    return (bb, bbw, bb_len, n_layers, seqs, ws, lens, begins, ends, wband)
 
 
-def _submit(kernel, packed, use_pallas):
-    """Dispatch one packed chunk; returns device futures (async)."""
-    bb, bbw, bb_len, n_layers, seqs, ws, lens, begins, ends = packed
+def _submit(kernel, packed, use_pallas, banded=False):
+    """Dispatch one packed chunk; returns device futures (async).
+    `packed` is _pack's 10-tuple (trailing per-window half-band row) or
+    a legacy 9-tuple from flat-only callers (probes, the multichip
+    worker) — the band row is only touched on banded dispatch."""
+    bb, bbw, bb_len, n_layers, seqs, ws, lens, begins, ends = packed[:9]
     if use_pallas:
-        return kernel(bb_len[:, None], n_layers[:, None], lens, begins,
-                      ends, bb.astype(np.int32), bbw, seqs.astype(np.int32),
-                      ws)
+        args = [bb_len[:, None], n_layers[:, None], lens, begins,
+                ends, bb.astype(np.int32), bbw, seqs.astype(np.int32), ws]
+        if banded:
+            args.append(packed[9])
+        return kernel(*args)
     return kernel(bb, bbw, bb_len, n_layers, seqs, ws, lens, begins, ends)
 
 
-def _unpack(outs, use_pallas):
+def _unpack(outs, use_pallas, banded=False):
     """Block on device futures; normalize to host arrays."""
     cb, cc, cl, fl = outs[0], outs[1], outs[2], outs[3]
     cons_base = np.asarray(cb)
@@ -837,21 +920,45 @@ def _unpack(outs, use_pallas):
     if use_pallas:
         cons_len = cons_len[:, 0]
         failed = failed[:, 0]
+        if banded:
+            return (cons_base, cons_cov, cons_len, failed,
+                    np.asarray(outs[5])[:, 0])
     return cons_base, cons_cov, cons_len, failed
 
 
 def _install(pipeline, chunk, results, trim, stats, fallback, report=None,
-             tier=None, journal=None):
+             tier=None, journal=None, band_states=None, band_cap=0,
+             force_hit=False):
     san = _sanitize()
     sanitizing = san.enabled()
     if sanitizing:
         # Concrete-side invariants (the kernel proxy skips traced calls):
         # in-range lengths/codes, boolean failed flags. The sanitize.nan
         # fault fires in here against a checker-only copy.
-        san.check_consensus_outputs(results, [i for i, _, _ in chunk],
+        san.check_consensus_outputs(results[:4], [i for i, _, _ in chunk],
                                     where=f"poa._install[{tier or 'device'}]")
-    cons_base, cons_cov, cons_len, failed = results
+    if len(results) == 5:
+        cons_base, cons_cov, cons_len, failed, band_hit = results
+    else:
+        cons_base, cons_cov, cons_len, failed = results
+        band_hit = None
+    retry = []
     for bi, (i, wx, keep) in enumerate(chunk):
+        st = band_states.get(i) if band_states else None
+        if st is not None and st.k:
+            # banded dispatch: a kernel hit flag — or any failure, which
+            # under a band may just mean the masked DP lost the path —
+            # advances the verify-and-widen ladder instead of installing
+            hit_bi = force_hit or (band_hit is not None
+                                   and bool(band_hit[bi]))
+            if hit_bi or failed[bi]:
+                st.widen_width(band_cap, report, tier=tier or "device")
+                if st.k and obs.enabled():
+                    obs.count("poa.cells.banded",
+                              len(keep) * (2 * st.k + 1))
+                retry.append((i, wx, keep))
+                continue
+            st.pending = False
         if failed[bi]:
             fallback.append(i)
             stats["failed"] += 1
@@ -895,3 +1002,4 @@ def _install(pipeline, chunk, results, trim, stats, fallback, report=None,
         stats["device"] += 1
         if report is not None and tier is not None:
             report.record_served(tier)
+    return retry

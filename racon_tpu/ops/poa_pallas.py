@@ -71,7 +71,7 @@ def blocked_width(n: int) -> int:
 
 @device_keyed_cache(maxsize=32)
 def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False,
-                            colstep: bool = True):
+                            colstep: bool = True, band: bool = False):
     N = cfg.max_nodes
     L = cfg.max_len
     BB = cfg.max_backbone
@@ -89,13 +89,33 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False,
 
     VSLOT = 15  # pred-slot sentinel meaning "virtual start row"
 
-    def kernel(bb_len_ref, n_layers_ref, lens_ref, begins_ref, ends_ref,
-               bb_ref, bbw_ref, seqs_hbm, ws_hbm,
-               cons_base_ref, cons_cov_ref, cons_len_ref, failed_ref,
-               n_nodes_ref,
-               H, MV, base, key, cov, order, in_src, in_w, in_cnt,
-               nkey, runrem, score, pred, revbuf, esc, rank_of,
-               seq_scr, w_scr, dma_sem):
+    # The banded build (band=True, RACON_TPU_BAND) adds one SMEM input
+    # (wband: the per-window half-band width) and one SMEM output
+    # (band_hit: traceback touched the band boundary, or the terminal
+    # score's deficit exceeded the gap-cost bound — ops/band.py owns the
+    # verify-and-widen ladder that consumes it).  Every band operation
+    # is gated on the Python-level `band` flag so the flat build traces
+    # to an unchanged jaxpr, and on `wband > 0` at runtime so a widened-
+    # to-flat window (wband == 0) runs exact flat semantics through the
+    # same compiled kernel.
+    def kernel(*refs):
+        if band:
+            (bb_len_ref, n_layers_ref, lens_ref, begins_ref, ends_ref,
+             bb_ref, bbw_ref, seqs_hbm, ws_hbm, wband_ref,
+             cons_base_ref, cons_cov_ref, cons_len_ref, failed_ref,
+             n_nodes_ref, band_hit_ref,
+             H, MV, base, key, cov, order, in_src, in_w, in_cnt,
+             nkey, runrem, score, pred, revbuf, esc, rank_of,
+             seq_scr, w_scr, dma_sem) = refs
+            wb = wband_ref[0, 0, 0]
+        else:
+            (bb_len_ref, n_layers_ref, lens_ref, begins_ref, ends_ref,
+             bb_ref, bbw_ref, seqs_hbm, ws_hbm,
+             cons_base_ref, cons_cov_ref, cons_len_ref, failed_ref,
+             n_nodes_ref,
+             H, MV, base, key, cov, order, in_src, in_w, in_cnt,
+             nkey, runrem, score, pred, revbuf, esc, rank_of,
+             seq_scr, w_scr, dma_sem) = refs
         jlane = jax.lax.broadcasted_iota(jnp.int32, (8, JW), 1)
         jsub = jax.lax.broadcasted_iota(jnp.int32, (8, JW), 0)
         jj = jsub * JW + jlane                      # j index per element
@@ -223,7 +243,10 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False,
 
         # ---- one layer ----------------------------------------------------
         def do_layer(li, slot, carry):
-            n, failed = carry
+            if band:
+                n, failed, hit = carry
+            else:
+                n, failed = carry
             Ln = lens_ref[0, 0, li]
             begin = begins_ref[0, 0, li]
             end = ends_ref[0, 0, li]
@@ -298,6 +321,15 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False,
                 V = jnp.where(choose_diag, diag, up)
                 vmove = jnp.where(choose_diag, 4 * Ssh, 1 + 4 * Pslot)
                 row = cummaxj(V - gvec) + gvec
+                if band:
+                    # diagonal band: node u's expected column is its
+                    # backbone key minus the layer's begin; cells more
+                    # than wband off that center are masked to NEG, so
+                    # later rows, the end-score pick and the traceback
+                    # all see banded values
+                    cexp = (loadn(key[:], u) + 0.5).astype(jnp.int32) - begin
+                    row = jnp.where((wb > 0) & (jnp.abs(jj - cexp) > wb),
+                                    NEG, row)
                 # left only if strictly better
                 mv = jnp.where(row > V, 2, vmove)
                 H[pl.ds(u + 1, 1)] = row.reshape(1, 8, JW)
@@ -343,6 +375,13 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False,
                                        SN)).astype(jnp.int32)
             best_u = jnp.where(best_s > NEG, loadn(order[:], best_r),
                                jnp.int32(-1))
+            if band:
+                # score-deficit verify: a terminal score this far below
+                # the all-match ceiling means the off-band penalty bound
+                # no longer certifies the banded optimum (host mirror:
+                # band.poa_deficit_bound)
+                hit = hit | ((wb > 0) & (M * Ln - best_s >
+                                         2 * (-G) * jnp.maximum(wb // 2, 1)))
 
             # ---- traceback -------------------------------------------------
             # The walk visits j strictly downward, so the backward
@@ -353,11 +392,11 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False,
             # old pos_node array and its separate backward sweep are gone.
 
             def tb_cond(c):
-                u, j, steps, nk, run = c
+                u, j, steps = c[0], c[1], c[2]
                 return (~((u == -1) & (j == 0))) & (steps < N + L + 2)
 
             def tb_body(c):
-                u, j, steps, nk, run = c
+                u, j, steps, nk, run = c[:5]
                 at_virtual = u == -1
                 uc = jnp.maximum(u, 0)
                 jm1 = jnp.maximum(j - 1, 0)
@@ -382,12 +421,28 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False,
 
                 new_u = jnp.where(take_diag | take_up, prd, u)
                 new_j = jnp.where(take_up, j, j - 1)
-                return (new_u, new_j, steps + 1, nk, run)
+                out = (new_u, new_j, steps + 1, nk, run)
+                if band:
+                    # boundary touch: the optimal path came within one
+                    # cell of the band edge — the true optimum may lie
+                    # outside, so the window must be re-run wider
+                    cu = (loadn(key[:], uc) + 0.5).astype(jnp.int32) - begin
+                    near = (~at_virtual & (wb > 0) &
+                            (jnp.abs(j - cu) >= wb - 1))
+                    out = out + (c[5] | near,)
+                return out
 
-            fu, fj, _, _, _ = jax.lax.while_loop(
-                tb_cond, tb_body,
-                (best_u, Ln, jnp.int32(0), jnp.float32(KEY_INF),
-                 jnp.int32(0)))
+            if band:
+                fu, fj, _, _, _, touch = jax.lax.while_loop(
+                    tb_cond, tb_body,
+                    (best_u, Ln, jnp.int32(0), jnp.float32(KEY_INF),
+                     jnp.int32(0), jnp.bool_(False)))
+                hit = hit | touch
+            else:
+                fu, fj, _, _, _ = jax.lax.while_loop(
+                    tb_cond, tb_body,
+                    (best_u, Ln, jnp.int32(0), jnp.float32(KEY_INF),
+                     jnp.int32(0)))
             failed = failed | ~((fu == -1) & (fj == 0))
 
             # ---- graph update ----------------------------------------------
@@ -475,14 +530,14 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False,
             n, failed, _, _, _ = jax.lax.fori_loop(
                 0, Ln, upd_body,
                 (n, failed, jnp.int32(-1), jnp.float32(-1.0), jnp.int32(0)))
-            return (n, failed)
+            return (n, failed, hit) if band else (n, failed)
 
         @pl.when(n_layers > 0)
         def _():
             start_copy(0, 0)
 
         def layer_loop(li, carry):
-            n, failed = carry
+            failed = carry[1]
             slot = jax.lax.rem(li, 2)
             wait_copy(li, slot)
 
@@ -493,10 +548,15 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False,
 
             run = (lens_ref[0, 0, li] > 0) & ~failed
             return jax.lax.cond(run, lambda c: do_layer(li, slot, c),
-                                lambda c: c, (n, failed))
+                                lambda c: c, carry)
 
-        n, failed = jax.lax.fori_loop(
-            0, n_layers, layer_loop, (bb_len, jnp.bool_(False)))
+        if band:
+            n, failed, hit = jax.lax.fori_loop(
+                0, n_layers, layer_loop,
+                (bb_len, jnp.bool_(False), jnp.bool_(False)))
+        else:
+            n, failed = jax.lax.fori_loop(
+                0, n_layers, layer_loop, (bb_len, jnp.bool_(False)))
 
         # ---- consensus -----------------------------------------------------
         def score_body(r, c):
@@ -581,6 +641,8 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False,
         cons_len_ref[0, 0, 0] = cnt
         failed_ref[0, 0, 0] = failed.astype(jnp.int32)
         n_nodes_ref[0, 0, 0] = n
+        if band:
+            band_hit_ref[0, 0, 0] = hit.astype(jnp.int32)
 
     def make(batch: int):
         # Mosaic block rules: last two block dims must tile (8,128) or equal
@@ -593,19 +655,20 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False,
                             memory_space=pltpu.VMEM)
         hbm = pl.BlockSpec(memory_space=pl.ANY)
 
+        scal = jax.ShapeDtypeStruct((batch, 1, 1), jnp.int32)
         return pl.pallas_call(
             kernel,
             grid=(batch,),
             in_specs=[smem3(1), smem3(1), smem3(D), smem3(D), smem3(D),
-                      vblk, vblk, hbm, hbm],
-            out_specs=[vblk, vblk, smem3(1), smem3(1), smem3(1)],
+                      vblk, vblk, hbm, hbm] +
+                     ([smem3(1)] if band else []),
+            out_specs=[vblk, vblk, smem3(1), smem3(1), smem3(1)] +
+                      ([smem3(1)] if band else []),
             out_shape=[
                 jax.ShapeDtypeStruct((batch, 8, NW), jnp.int32),
                 jax.ShapeDtypeStruct((batch, 8, NW), jnp.int32),
-                jax.ShapeDtypeStruct((batch, 1, 1), jnp.int32),
-                jax.ShapeDtypeStruct((batch, 1, 1), jnp.int32),
-                jax.ShapeDtypeStruct((batch, 1, 1), jnp.int32),
-            ],
+                scal, scal, scal,
+            ] + ([scal] if band else []),
             scratch_shapes=[
                 pltpu.VMEM((N + 1, 8, JW), jnp.int32),  # H
                 pltpu.VMEM((N + 1, 8, JW), jnp.int32),  # MV (move records)
@@ -634,7 +697,8 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False,
     def jitted(batch: int):
         call = make(batch)
 
-        def fn(bb_len, n_layers, lens, begins, ends, bb, bbw, seqs, ws):
+        def fn(bb_len, n_layers, lens, begins, ends, bb, bbw, seqs, ws,
+               *extra):
             # host-shaped inputs -> sublane-blocked tiles (XLA relayouts
             # on device; the pallas kernel sees native (8, W) tiles)
             bbB = jnp.pad(bb.reshape(batch, BB),
@@ -645,14 +709,21 @@ def build_pallas_poa_kernel(cfg: PoaConfig, interpret: bool = False,
                             constant_values=255).reshape(batch, D, 8, JW)
             wsB = jnp.pad(ws, ((0, 0), (0, 0), (0, SJ - L))
                           ).reshape(batch, D, 8, JW)
-            cb, cc, cl, fl, nn = call(
-                bb_len.reshape(batch, 1, 1), n_layers.reshape(batch, 1, 1),
-                lens.reshape(batch, 1, D), begins.reshape(batch, 1, D),
-                ends.reshape(batch, 1, D), bbB, bbwB, seqsB, wsB)
-            return (cb.reshape(batch, SN)[:, :N],
-                    cc.reshape(batch, SN)[:, :N],
-                    cl.reshape(batch, 1), fl.reshape(batch, 1),
-                    nn.reshape(batch, 1))
+            args = [bb_len.reshape(batch, 1, 1),
+                    n_layers.reshape(batch, 1, 1),
+                    lens.reshape(batch, 1, D), begins.reshape(batch, 1, D),
+                    ends.reshape(batch, 1, D), bbB, bbwB, seqsB, wsB]
+            if band:
+                args.append(extra[0].reshape(batch, 1, 1))
+            outs = call(*args)
+            cb, cc, cl, fl, nn = outs[:5]
+            res = (cb.reshape(batch, SN)[:, :N],
+                   cc.reshape(batch, SN)[:, :N],
+                   cl.reshape(batch, 1), fl.reshape(batch, 1),
+                   nn.reshape(batch, 1))
+            if band:
+                res = res + (outs[5].reshape(batch, 1),)
+            return res
 
         return jax.jit(fn)
 

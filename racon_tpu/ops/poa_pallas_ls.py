@@ -62,7 +62,7 @@ def _round_up(x, m):
 
 @device_keyed_cache(maxsize=32)
 def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
-                              colstep: bool = True):
+                              colstep: bool = True, band: bool = False):
     N = cfg.max_nodes
     L = cfg.max_len
     BB = cfg.max_backbone
@@ -76,12 +76,27 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
     X = int(cfg.mismatch)
     GP = int(cfg.gap)
 
-    def kernel(bb_len_s, n_layers_s, lens_s, begins_s, ends_s,
-               bb_ref, bbw_ref, seqs_hbm, ws_hbm,
-               cons_base_ref, cons_cov_ref, cl_s, fl_s, nn_s, hbm_H,
-               Hring, H0, rk_base, rk_key, rk_cov, rk_cnt, rk_delta, rk_ew,
-               rk_dmax, esc, score, spred, revbuf, nkey, runrem,
-               seq_scr, w_scr, dma_sem, flush_sem, tb_sem):
+    # The banded build (band=True, RACON_TPU_BAND) adds one SMEM input
+    # (wband: per-window half-band width, 0 = flat semantics through the
+    # same compiled kernel) and one SMEM output (band_hit: the composite
+    # verify signal — see poa_pallas.py / ops/band.py).  Every band op
+    # is gated on the Python-level `band` flag so the flat build's jaxpr
+    # is unchanged.
+    def kernel(*refs):
+        if band:
+            (bb_len_s, n_layers_s, lens_s, begins_s, ends_s,
+             bb_ref, bbw_ref, seqs_hbm, ws_hbm, wband_s,
+             cons_base_ref, cons_cov_ref, cl_s, fl_s, nn_s, bh_s, hbm_H,
+             Hring, H0, rk_base, rk_key, rk_cov, rk_cnt, rk_delta, rk_ew,
+             rk_dmax, esc, score, spred, revbuf, nkey, runrem,
+             seq_scr, w_scr, dma_sem, flush_sem, tb_sem) = refs
+        else:
+            (bb_len_s, n_layers_s, lens_s, begins_s, ends_s,
+             bb_ref, bbw_ref, seqs_hbm, ws_hbm,
+             cons_base_ref, cons_cov_ref, cl_s, fl_s, nn_s, hbm_H,
+             Hring, H0, rk_base, rk_key, rk_cov, rk_cnt, rk_delta, rk_ew,
+             rk_dmax, esc, score, spred, revbuf, nkey, runrem,
+             seq_scr, w_scr, dma_sem, flush_sem, tb_sem) = refs
         b_prog = pl.program_id(0)
 
         lane_n = jax.lax.broadcasted_iota(jnp.int32, (NC, G, 128), 2)
@@ -194,6 +209,8 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
         bb_len = svec(lambda g: bb_len_s[0, g])
         n_layers = svec(lambda g: n_layers_s[0, g])
         max_layers = jnp.max(n_layers)
+        if band:
+            wbv = svec(lambda g: wband_s[0, g])       # (1,G,1) half-band
 
         # ---- graph init from the backbone chain ------------------------
         # (parity: rt_poa.cpp add_alignment, empty-alignment branch)
@@ -240,7 +257,10 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
 
         # ================= one layer =====================================
         def do_layer(li, slot, carry):
-            n, failed = carry                          # (1,G,1) i32
+            if band:
+                n, failed, hit = carry                 # (1,G,1) i32
+            else:
+                n, failed = carry                      # (1,G,1) i32
             Ln = svec(lambda g: lens_s[0, g, li])
             begin = svec(lambda g: begins_s[0, g, li])
             end = svec(lambda g: ends_s[0, g, li])
@@ -320,6 +340,14 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
                 up = P + GP
                 V = jnp.maximum(diag, up)
                 row = cummaxj(V - gvec) + gvec
+                if band:
+                    # diagonal band around the rank's backbone offset:
+                    # cells past the per-window half-band are masked to
+                    # NEG before the ring write, so later ranks, the end
+                    # score and the traceback all see banded values
+                    cr = (exr(rk_key, r) + 0.5).astype(jnp.int32) - begin
+                    row = jnp.where((wbv > 0) & (jnp.abs(jj - cr) > wbv),
+                                    NEG, row)
                 Hring[pl.ds(r % RING, 1)] = row[None]
                 rmw(esc, r, ex_v(row, Ln), act)
 
@@ -400,6 +428,11 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
                              axis=(0, 2), keepdims=True)[:, :, 0:1]
             has_end = best_s > NEG
             failed = failed | jnp.where(lact & ~has_end, 1, 0)
+            if band:
+                # score-deficit verify (host mirror: band.poa_deficit_bound)
+                deficit_bad = (M * Ln - best_s >
+                               2 * (-GP) * jnp.maximum(wbv // 2, 1))
+                hit = hit | jnp.where(lact & (wbv > 0) & deficit_bad, 1, 0)
 
             # ---- traceback: block-descending re-derivation --------------
             walking = lact & has_end & (failed == 0)
@@ -434,7 +467,7 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
                 tb_load(b_top - 1, (b_top - 1) % 2)
 
             def tb_rank_work(r, c):
-                cur, jcur, nk, run, done, failed = c
+                cur, jcur, nk, run, done, failed = c[:6]
                 here = ~done & (cur == r)
                 row = ring_row(r)
                 ub = exr(rk_base, r)
@@ -484,6 +517,15 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
                 done = done | stuck
                 act = here & ~stuck
                 j_stop = jnp.maximum(j_stop, 0)
+                if band:
+                    # boundary touch: a column visited at this rank came
+                    # within one cell of the band edge (the run's extreme
+                    # columns are j_stop and the entry jcur)
+                    cr_tb = (exr(rk_key, r) + 0.5).astype(jnp.int32) - begin
+                    near = act & (wbv > 0) & (
+                        (jnp.abs(j_stop - cr_tb) >= wbv - 1) |
+                        (jnp.abs(jcur - cr_tb) >= wbv - 1))
+                    hit_tb = c[6] | jnp.where(near, 1, 0)
 
                 lanes = (jj >= j_stop) & (jj < jcur) & act
                 runrem[...] = jnp.where(lanes, run + (jcur - jj),
@@ -523,7 +565,10 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
                 runrem[...] = jnp.where(vl, run + (jcur - jj), runrem[...])
                 nkey[...] = jnp.where(vl, nk, nkey[...])
                 done = done | at_virt
-                return (cur, jcur, nk, run, done, failed)
+                out = (cur, jcur, nk, run, done, failed)
+                if band:
+                    out = out + (hit_tb,)
+                return out
 
             def tb_rank(i, c):
                 b = c[0]
@@ -549,9 +594,14 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
                     tb_load(b - 2, b % 2)
                 return c2
 
-            cur, jcur, nk, run, done, failed = jax.lax.fori_loop(
-                0, b_top + 1, tb_block,
-                (cur, jcur, nk0, run0, done0, failed))
+            if band:
+                cur, jcur, nk, run, done, failed, hit = jax.lax.fori_loop(
+                    0, b_top + 1, tb_block,
+                    (cur, jcur, nk0, run0, done0, failed, hit))
+            else:
+                cur, jcur, nk, run, done, failed = jax.lax.fori_loop(
+                    0, b_top + 1, tb_block,
+                    (cur, jcur, nk0, run0, done0, failed))
             failed = failed | jnp.where(~done & lact, 1, 0)
 
             # ---- graph update (parity: rt_poa.cpp add_alignment) --------
@@ -679,7 +729,7 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
                  jnp.full((1, G, 1), -1, jnp.int32),
                  jnp.full((1, G, 1), -1.0, jnp.float32),
                  jnp.zeros((1, G, 1), jnp.int32)))
-            return (n, failed)
+            return (n, failed, hit) if band else (n, failed)
 
         @pl.when(max_layers > 0)
         def _():
@@ -695,9 +745,15 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
 
             return do_layer(li, slot, carry)
 
-        n, failed = jax.lax.fori_loop(
-            0, max_layers, layer_loop,
-            (bb_len, jnp.zeros((1, G, 1), jnp.int32)))
+        if band:
+            n, failed, hit = jax.lax.fori_loop(
+                0, max_layers, layer_loop,
+                (bb_len, jnp.zeros((1, G, 1), jnp.int32),
+                 jnp.zeros((1, G, 1), jnp.int32)))
+        else:
+            n, failed = jax.lax.fori_loop(
+                0, max_layers, layer_loop,
+                (bb_len, jnp.zeros((1, G, 1), jnp.int32)))
 
         # ================= consensus =====================================
         # (parity: rt_poa.cpp generate_consensus — heaviest bundle)
@@ -802,6 +858,8 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
             cl_s[0, g] = scalar_of(cnt_f, g)
             fl_s[0, g] = jnp.where(scalar_of(failed, g) > 0, 1, 0)
             nn_s[0, g] = scalar_of(n, g)
+            if band:
+                bh_s[0, g] = jnp.where(scalar_of(hit, g) > 0, 1, 0)
 
     def make(batch: int):
         assert batch % G == 0
@@ -814,18 +872,19 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
                             memory_space=pltpu.VMEM)
         hbm = pl.BlockSpec(memory_space=pl.ANY)
 
+        gshape = jax.ShapeDtypeStruct((nb, G), jnp.int32)
         return pl.pallas_call(
             kernel,
             grid=(nb,),
             in_specs=[smem2, smem2, smem3, smem3, smem3, vblk, vblk,
-                      hbm, hbm],
-            out_specs=[vblk, vblk, smem2, smem2, smem2, hbm],
+                      hbm, hbm] + ([smem2] if band else []),
+            out_specs=[vblk, vblk, smem2, smem2, smem2] +
+                      ([smem2] if band else []) + [hbm],
             out_shape=[
                 jax.ShapeDtypeStruct((nb, NC, G, 128), jnp.int32),
                 jax.ShapeDtypeStruct((nb, NC, G, 128), jnp.int32),
-                jax.ShapeDtypeStruct((nb, G), jnp.int32),
-                jax.ShapeDtypeStruct((nb, G), jnp.int32),
-                jax.ShapeDtypeStruct((nb, G), jnp.int32),
+                gshape, gshape, gshape,
+            ] + ([gshape] if band else []) + [
                 jax.ShapeDtypeStruct((nb, N, JC, G, 128), jnp.int32),
             ],
             scratch_shapes=[
@@ -858,7 +917,8 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
         call = make(batch)
         nb = batch // G
 
-        def fn(bb_len, n_layers, lens, begins, ends, bb, bbw, seqs, ws):
+        def fn(bb_len, n_layers, lens, begins, ends, bb, bbw, seqs, ws,
+               *extra):
             def to_n(x):
                 x = jnp.pad(x.reshape(batch, BB), ((0, 0), (0, N - BB)))
                 return x.reshape(nb, G, NC, 128).transpose(0, 2, 1, 3)
@@ -870,14 +930,21 @@ def build_lockstep_poa_kernel(cfg: PoaConfig, interpret: bool = False,
                 0, 2, 3, 1, 4)
             wsJ = wsJ.reshape(nb, G, D, JC, 128).transpose(0, 2, 3, 1, 4)
 
-            cb, cc, cl, fl, nn, _ = call(
-                bb_len.reshape(nb, G), n_layers.reshape(nb, G),
-                lens.reshape(nb, G, D), begins.reshape(nb, G, D),
-                ends.reshape(nb, G, D), to_n(bb), to_n(bbw), seqsJ, wsJ)
+            args = [bb_len.reshape(nb, G), n_layers.reshape(nb, G),
+                    lens.reshape(nb, G, D), begins.reshape(nb, G, D),
+                    ends.reshape(nb, G, D), to_n(bb), to_n(bbw),
+                    seqsJ, wsJ]
+            if band:
+                args.append(extra[0].reshape(nb, G))
+            outs = call(*args)
+            cb, cc, cl, fl, nn = outs[:5]
             cb = cb.transpose(0, 2, 1, 3).reshape(batch, N)
             cc = cc.transpose(0, 2, 1, 3).reshape(batch, N)
-            return (cb, cc, cl.reshape(batch, 1), fl.reshape(batch, 1),
-                    nn.reshape(batch, 1))
+            res = (cb, cc, cl.reshape(batch, 1), fl.reshape(batch, 1),
+                   nn.reshape(batch, 1))
+            if band:
+                res = res + (outs[5].reshape(batch, 1),)
+            return res
 
         return jax.jit(fn)
 

@@ -63,6 +63,12 @@ KNOWN_POINTS = frozenset({
     "align.install",     # phase-1 CIGAR install, per job (after the
                          # lattice: an escape mid-install must not erase
                          # the device-served count — see align_driver)
+    "band.hit",          # banded DP verify (ops/band.py): an armed
+                         # fault (raise=MosaicError/InjectedFault)
+                         # classifies every banded job of the attempt as
+                         # a band hit instead of raising — the
+                         # deterministic widening-exhaustion drill that
+                         # drives the ladder to its flat floor
     "poa.compile.ls",    # lockstep consensus kernel build
     "poa.compile.v2",    # one-window consensus kernel build
     "poa.compile.xla",   # XLA-twin consensus kernel build

@@ -186,6 +186,22 @@ def next_consensus_tier(kind: str) -> str:
     return CONSENSUS_TIERS[min(i + 1, len(CONSENSUS_TIERS) - 1)]
 
 
+def record_band_fallback(report, tier: str, cause=None) -> None:
+    """The `banded -> flat` lattice edge, recorded once per job.
+
+    Orthogonal to tier demotion (like the sharded -> single-device
+    edge): the job stays at `tier`, only the DP band is dropped — the
+    flat kernel is the byte-identity oracle, so the floor of the
+    verify-and-widen ladder can never change output.  Shows up in the
+    report's degradation list as `<tier>+banded -> <tier>` and in the
+    metrics as `band.fallbacks`, so a band that keeps getting hit is
+    visible in any trace or run report."""
+    exc = cause if isinstance(cause, BaseException) else None
+    if report is not None:
+        report.record_degrade(f"{tier}+banded", tier, exc)
+    obs.count("band.fallbacks")
+
+
 def record_shard_demotion(report, tier: str, cause) -> None:
     """The `sharded -> single-device` lattice edge, recorded once.
 

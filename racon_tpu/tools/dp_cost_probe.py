@@ -40,18 +40,32 @@ mode, and times each:
   mode 14: mode 13 PACKED (align_pallas.py pack path): one packed-word
           loadn per iteration, 4 byte-extracted rows scored per step —
           the serial trip count drops to ceil(R / 4)
+  mode 15: banded-aligner FLAT baseline — the mode-13 recurrence on a
+          full 1024-lane (8, 128) band row; the counter output returns
+          IN-LOOP CELLS (lanes scored per DP row), not iterations
+  mode 16: mode 15 on the banded 128-lane rung (ops/band.py ladder
+          floor), band offset advancing along the diagonal per row —
+          8x fewer in-loop cells
+  mode 17: banded-POA FLAT baseline — an ls-shape rank row of 13 lane
+          chunks (1664 columns) with chunk-prefix cummax and a VMEM
+          ring write; counter returns in-loop cells per rank
+  mode 18: mode 17 BANDED: only a 4-chunk window around the rank's
+          backbone column is read/scored/written (`pl.ds(cb0, CB)`
+          windowed ring access) — 13/4 = 3.25x fewer in-loop cells
 
 mode 4 approximates the full v2 dp_body; mode 10 approximates the ls
 dp_body. The deltas between modes say which component to attack next;
 per-node microseconds are printed for each.
 
-Every kernel also returns its MEASURED serial loop-iteration count (a
-carry incremented inside the loop body, read back via a second SMEM
-output) — `--gate` compares the compressed modes against their
-baselines on those measured counts and exits nonzero unless the ratios
-clear the floors (11 vs 1 and 12 vs 9: >= 1.5x; 14 vs 13: >= 2x).
-Interpret-mode safe: the gate measures trip counts, not wall time, so
-CI runs it on CPU.
+Every kernel also returns a MEASURED in-loop count via a second SMEM
+output — serial loop iterations for modes 0-14, scored DP cells for
+the banded modes 15-18 — and `--gate` compares the compressed modes
+against their baselines on those measured counts, exiting nonzero
+unless the ratios clear the floors (11 vs 1 and 12 vs 9: >= 1.5x
+steps; 14 vs 13: >= 2x steps; 16 vs 15 and 18 vs 17: >= 3x cells, the
+RACON_TPU_BAND acceptance floor for BOTH hot kernels).
+Interpret-mode safe: the gate measures counts, not wall time, so CI
+runs it on CPU.
 
 Usage: python racon_tpu/tools/dp_cost_probe.py [R] [B] [reps]
        python racon_tpu/tools/dp_cost_probe.py --gate
@@ -85,6 +99,9 @@ def build(mode: int, R: int, B: int, interpret: bool):
     JC = 4       # lane chunks per lockstep row (modes 9/10)
     RING = 128   # lockstep H ring rows (modes 9/10)
     GSLOTS = 16  # lockstep graph-row slots (mode 10 dynamic loads)
+    JC2 = 13     # banded-POA flat row chunks, 1664 cols (modes 17/18)
+    CB = 4       # banded-POA live window chunks (mode 18)
+    RING2 = 8    # banded-POA H ring rows (modes 17/18)
 
     def kernel(seed_ref, out_ref, steps_ref, H, order, base, key, in_cnt,
                in_src, has_out, gls):
@@ -386,6 +403,105 @@ def build(mode: int, R: int, B: int, interpret: bool):
             out_ref[0, 0, 0] = row[0, 0] + row[0, 1]
             return
 
+        if mode in (15, 16):
+            # banded-aligner CELL gate (ops/band.py): mode 15 scores a
+            # full 1024-lane (8, 128) band row per DP row; mode 16 keeps
+            # the 128-lane banded rung, its lane->column mapping
+            # advancing one diagonal per row (the Ukkonen band offset).
+            # The counter output is IN-LOOP CELLS, not iterations — the
+            # serial chain length is identical by construction (banding
+            # narrows live lanes per row, it does not shorten the row
+            # chain), which is exactly the claim the cost model makes.
+            AS = 8 if mode == 15 else 1
+            blane = jax.lax.broadcasted_iota(jnp.int32, (AS, 128), 1)
+            bsub = jax.lax.broadcasted_iota(jnp.int32, (AS, 128), 0)
+            bjj = bsub * 128 + blane
+            row0 = bjj * G + seed_ref[0, 0, 0]
+            base[:] = nn_i % 5             # query codes, one per slot
+
+            def bstep(r, c):
+                row, cells = c
+                qc = loadn(base[:], r)
+                # mode 16: lane j of the banded row is global column
+                # j + r (band advances along the main diagonal)
+                col = bjj + (r if mode == 16 else 0)
+                scvec = jnp.where(col % 5 == qc, 5, -4)
+                ln = pltpu.roll(row, 1, 1)
+                if AS > 1:
+                    carry = pltpu.roll(ln, 1, 0)
+                    ln = jnp.where(blane == 0, carry, ln)
+                dshift = jnp.where(bjj == 0, NEG, ln)
+                diag = dshift + scvec
+                up = row + G
+                return (jnp.where(diag >= up, diag, up),
+                        cells + AS * 128)
+
+            row, cells = jax.lax.fori_loop(
+                0, R, bstep, (row0, jnp.int32(0)))
+            steps_ref[0, 0, 0] = cells
+            out_ref[0, 0, 0] = row[0, 0] + row[0, 1]
+            return
+
+        if mode in (17, 18):
+            # banded-POA CELL gate: ls-shape rank rows of JC2 lane
+            # chunks (13 * 128 = 1664 columns, the production wl-class).
+            # Mode 17 reads/scores/writes all 13 chunks per rank; mode
+            # 18 touches only a CB-chunk window around the rank's
+            # backbone column via `pl.ds(cb0, CB)` on a flattened
+            # (RING2 * JC2, ...) ring — the windowed access pattern of
+            # the banded POA kernels.  Counter output is in-loop cells.
+            W = JC2 if mode == 17 else CB
+            wlane = jax.lax.broadcasted_iota(jnp.int32, (W, 8, 128), 2)
+            wchunk = jax.lax.broadcasted_iota(jnp.int32, (W, 8, 128), 0)
+            wjj = wchunk * 128 + wlane
+            wg = wjj * G
+            # every ring slot holds defined, seed-derived data (mode 18
+            # reads windows row r+1 never wrote; see modes 9/10 note)
+            ring_i = jax.lax.broadcasted_iota(
+                jnp.int32, (RING2 * JC2, 8, 128), 0)
+            H[:] = ring_i % 97 + seed_ref[0, 0, 0]
+
+            def wshift(x, fill):
+                ln = pltpu.roll(x, 1, 2)
+                carry = pltpu.roll(ln, 1, 0)
+                y = jnp.where(wlane == 0, carry, ln)
+                return jnp.where(wjj == 0, fill, y)
+
+            def wcummax(x):
+                w = 1
+                while w < 128:
+                    shs = [jnp.where(wlane >= k * w,
+                                     pltpu.roll(x, k * w, 2), NEG)
+                           for k in (1, 2, 3) if k * w < 128]
+                    x = tree_max([x] + shs)
+                    w *= 4
+                tot = jnp.max(x, axis=2, keepdims=True)
+                p = jnp.broadcast_to(tot, x.shape)
+                acc = jnp.full(x.shape, NEG, jnp.int32)
+                for k in range(1, W):
+                    acc = jnp.maximum(
+                        acc, jnp.where(wchunk >= k, pltpu.roll(p, k, 0),
+                                       NEG))
+                return jnp.maximum(x, acc)
+
+            def wrow(r, cells):
+                # window origin tracks the rank's backbone column
+                cb0 = jnp.clip(r * JC2 // R - CB // 2, 0, JC2 - W)
+                P = H[pl.ds((r % RING2) * JC2 + cb0, W)]
+                scvec = jnp.where(wjj % 4 == 1, 5, -4)
+                diag = wshift(P, NEG) + scvec
+                up = P + G
+                V = jnp.where(diag >= up, diag, up)
+                row = wcummax(V - wg) + wg
+                H[pl.ds(((r + 1) % RING2) * JC2 + cb0, W)] = row
+                return cells + W * 128
+
+            cells = jax.lax.fori_loop(0, R, wrow, jnp.int32(0))
+            steps_ref[0, 0, 0] = cells
+            hr = H[pl.ds((R % RING2) * JC2, 1)][0]
+            out_ref[0, 0, 0] = hr[0, 0] + hr[0, 1]
+            return
+
         # graph state init (content irrelevant; loads must be real)
         order[:] = nn_i
         base[:] = nn_i % 4
@@ -498,6 +614,9 @@ def build(mode: int, R: int, B: int, interpret: bool):
             pltpu.VMEM((R + 1, 1, 8 * JW) if mode == 6 else
                        (R + 1, 2, 8, JW) if mode == 8 else
                        (RING, JC, 8, 128) if mode in (9, 10, 12) else
+                       # flattened ring: leading dim = ring row * JC2 +
+                       # chunk, so the banded window is ONE pl.ds slice
+                       (RING2 * JC2, 8, 128) if mode in (17, 18) else
                        (R + 1, 8, JW), jnp.int32),   # H (ring, 9/10/12)
             pltpu.VMEM((8, NW), jnp.int32),          # order
             pltpu.VMEM((8, NW), jnp.int32),          # base
@@ -513,10 +632,12 @@ def build(mode: int, R: int, B: int, interpret: bool):
 
 
 def gate(R: int = 32, B: int = 1) -> bool:
-    """The CI serial-step gate: measured trip counts of the compressed
-    modes vs their baselines.  Runs in interpret mode off-TPU (counts,
-    not wall time, are the measurement), prints every ratio, returns
-    False if any floor is missed."""
+    """The CI gate: measured in-loop counts of the compressed modes vs
+    their baselines — serial trip counts for the step-compression pairs,
+    scored DP cells for the banded pairs (the RACON_TPU_BAND acceptance
+    floor: >= 3x fewer cells on BOTH hot kernels).  Runs in interpret
+    mode off-TPU (counts, not wall time, are the measurement), prints
+    every ratio, returns False if any floor is missed."""
     from racon_tpu.tools import force_cpu_if_requested
     force_cpu_if_requested()
     import jax
@@ -529,16 +650,18 @@ def gate(R: int = 32, B: int = 1) -> bool:
         jax.block_until_ready(steps)
         return int(np.asarray(steps)[0, 0, 0])
 
-    checks = (("poa-v2 colstep", 1, 11, 1.5),
-              ("poa-ls rank-pair", 9, 12, 1.5),
-              ("align row-pack", 13, 14, 2.0))
+    checks = (("poa-v2 colstep", 1, 11, 1.5, "serial steps"),
+              ("poa-ls rank-pair", 9, 12, 1.5, "serial steps"),
+              ("align row-pack", 13, 14, 2.0, "serial steps"),
+              ("align banded-band", 15, 16, 3.0, "in-loop cells"),
+              ("poa banded-window", 17, 18, 3.0, "in-loop cells"))
     ok = True
-    for name, base_m, new_m, floor in checks:
+    for name, base_m, new_m, floor, unit in checks:
         b, n = steps_of(base_m), steps_of(new_m)
         ratio = b / n if n else float("inf")
         good = ratio >= floor
         ok = ok and good
-        print(f"{name}: baseline mode {base_m} = {b} serial steps, "
+        print(f"{name}: baseline mode {base_m} = {b} {unit}, "
               f"compressed mode {new_m} = {n}, measured ratio "
               f"{ratio:.2f}x (floor {floor}x) "
               f"{'OK' if good else 'FAIL'}")
@@ -564,7 +687,7 @@ def main():
     interp = platform != "tpu"
     print(f"platform={platform} R={R} B={B}")
     prev = 0.0
-    for mode in range(15):
+    for mode in range(19):
         fn = build(mode, R, B, interp)
         seed = np.zeros((B, 1, 1), np.int32)
         t0 = time.time()
@@ -583,7 +706,7 @@ def main():
             dt = time.time() - t0
             best = dt if best is None else min(best, dt)
         rows = R * B * (2 if mode == 8 else
-                        8 if mode in (9, 10, 12) else 1)
+                        8 if mode in (9, 10, 12, 17, 18) else 1)
         per_node_us = best / rows * 1e6
         folded = " [FOLDED? output ignores seed — timing is fiction]" \
             if o1 == o2 else ""

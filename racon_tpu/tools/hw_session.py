@@ -90,6 +90,14 @@ PROBE = ("import jax, jax.numpy as jnp; "
 STEPS = [
     ("probe", [sys.executable, "-c", PROBE], 120, {}),
     ("bench", [sys.executable, "bench.py"], 2700, {}),
+    # banded-DP A/B on silicon: the default bench re-run with the
+    # verify-and-widen banding armed — the measured delta against
+    # `bench` is the band cell-cut's hardware evidence, and the logged
+    # entry carries the cells_banded / band_hit_rate stamps
+    # (checkpointed like every step: a wedge mid-pair resumes at the
+    # missing half)
+    ("bench_banded", [sys.executable, "bench.py"], 2700,
+     {"RACON_TPU_BAND": "1"}),
     # SAM input skips the alignment phase: kernel-vs-kernel consensus
     # comparison, ls tier then v2 — the decisive on-chip tier decision
     ("bench_sam", [sys.executable, "bench.py"], 2700,
@@ -240,6 +248,15 @@ def _trace_cost_validation(trace_path, cwd, timeout_s=120):
         return None
 
 
+def _strip_progress(text):
+    """Collapse ``\\r``-overwritten progress-bar frames to their final
+    state (keep only what follows the last carriage return on each
+    line), so the bounded tail captures spend their byte budget on real
+    output instead of a hundred redraws of the same bar."""
+    return "\n".join(ln.rsplit("\r", 1)[-1]
+                     for ln in (text or "").split("\n"))
+
+
 def _attempt(name, cmd, bound_s, env, cwd):
     """One bounded attempt.  Returns (outcome, tail, report|None,
     phase_walls, cost_model|None) with outcome in
@@ -268,7 +285,7 @@ def _attempt(name, cmd, bound_s, env, cwd):
     try:
         out, _ = p.communicate(timeout=bound_s)
         outcome = "ok" if p.returncode == 0 else "failed"
-        tail = (out or "")[-2000:]
+        tail = _strip_progress(out)[-2000:]
     except subprocess.TimeoutExpired:
         outcome = "timeout"
         try:
@@ -278,7 +295,7 @@ def _attempt(name, cmd, bound_s, env, cwd):
         # keep the partial output: 44 minutes of measured results before a
         # tunnel death ARE the evidence this tool exists to preserve
         out, _ = p.communicate()
-        tail = ((out or "")[-2000:] + f"\nTIMEOUT after {bound_s}s")
+        tail = (_strip_progress(out)[-2000:] + f"\nTIMEOUT after {bound_s}s")
     report = None
     try:
         with open(env["RACON_TPU_REPORT"]) as f:

@@ -137,6 +137,15 @@ def measure(mesh_n: int, repeats: int) -> dict:
     }
 
 
+def _strip_progress(text):
+    """Collapse ``\\r``-overwritten progress-bar frames to their final
+    state (keep only what follows the last carriage return on each
+    line), so the bounded tail captures spend their byte budget on real
+    output instead of a hundred redraws of the same bar."""
+    return "\n".join(ln.rsplit("\r", 1)[-1]
+                     for ln in (text or "").split("\n"))
+
+
 def _run_worker(mesh_n, repeats, real, force_host, bound_s):
     """One bounded subprocess per device count (backend init is one-way)."""
     cmd = [sys.executable, os.path.abspath(__file__),
@@ -156,7 +165,8 @@ def _run_worker(mesh_n, repeats, real, force_host, bound_s):
                 break
     return {"mesh": mesh_n, "ok": False,
             "error": f"rc={r.returncode}",
-            "tail": ((r.stderr or "") + (r.stdout or ""))[-800:]}
+            "tail": _strip_progress((r.stderr or "")
+                                    + (r.stdout or ""))[-800:]}
 
 
 def sweep(counts=DEFAULT_COUNTS, repeats=3, real=False, force_host=None,
@@ -184,7 +194,8 @@ def gate(n_devices=8, bound_s=1800):
              f"import __graft_entry__ as g; g.dryrun_multichip({n_devices})"],
             cwd=HERE, capture_output=True, text=True, timeout=bound_s,
             env=env)
-        rc, tail = r.returncode, ((r.stderr or "") + (r.stdout or ""))[-2000:]
+        rc, tail = r.returncode, _strip_progress(
+            (r.stderr or "") + (r.stdout or ""))[-2000:]
     except subprocess.TimeoutExpired:
         rc, tail = -1, f"gate timeout after {bound_s}s"
     return {"n_devices": n_devices, "rc": rc, "ok": rc == 0,

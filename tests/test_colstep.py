@@ -222,12 +222,14 @@ def test_colstep_polish_byte_identical_under_fault_demotion(tmp_path,
 # ------------------------------------------------ serial-step gate (CI)
 
 def test_probe_serial_step_gate(capsys):
-    """The dp_cost_probe gate: measured loop trip counts of the
-    compressed modes vs their baselines must clear the floors (>= 1.5x
-    for both POA shapes, >= 2x for the packed aligner)."""
+    """The dp_cost_probe gate: measured in-loop counts of the compressed
+    modes vs their baselines must clear the floors (>= 1.5x serial steps
+    for both POA shapes, >= 2x for the packed aligner, >= 3x in-loop
+    cells for the two banded pairs)."""
     from racon_tpu.tools import dp_cost_probe
 
     assert dp_cost_probe.gate()
     out = capsys.readouterr().out
-    assert out.count("OK") == 3 and "FAIL" not in out
+    assert out.count("OK") == 5 and "FAIL" not in out
+    assert out.count("in-loop cells") == 2
     assert "measured ratio" in out

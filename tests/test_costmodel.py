@@ -36,6 +36,11 @@ def test_grid_constants_match_ops_modules():
     from racon_tpu.ops import colstep, encoding
     assert costmodel.POA_COLSTEP_PACK == colstep.PACK
     assert costmodel.ALIGN_ROW_PACK == encoding.PACK
+    from racon_tpu import config
+    from racon_tpu.ops import band
+    assert costmodel.BAND_BUCKETS == band.BAND_BUCKETS
+    assert costmodel.BAND_SLACK == int(config.KNOBS[
+        "RACON_TPU_BAND_SLACK"].default)
     for bb in (1, 100, 128, 129, 500, 1000, 1024):
         assert costmodel.window_class(bb) == poa_driver.window_class(bb)
     # band_need is the `need` inside align_pallas.band_for: the bucket
@@ -77,6 +82,43 @@ def test_colstep_pack_divides_pallas_tier_serial_steps():
 def test_row_pack_divides_hirschberg_serial_steps():
     hs = costmodel.align_job_cost(1024, 256, "hirschberg")
     assert hs.serial_steps == 4.0 * 1024 / costmodel.ALIGN_ROW_PACK
+
+
+def test_banded_closed_forms_cut_cells_not_serial_steps():
+    """Banding narrows each DP row's live lanes: the cell/FLOP bill
+    divides by the band ratio, the latency-chained step count does not."""
+    flat = costmodel.align_job_cost(1024, 256, "hirschberg")
+    nar = costmodel.banded_align_job_cost(1024, 128)
+    assert nar.serial_steps == flat.serial_steps
+    assert nar.flops * 2 == flat.flops
+    assert costmodel.banded_cell_ratio("align", band=256, k=128) == 2.0
+
+    pf = costmodel.poa_window_cost(8, 512, "v2")
+    pb = costmodel.banded_poa_window_cost(8, 512, 8, "v2")
+    assert pb.serial_steps == pf.serial_steps
+    assert pb.hbm_bytes == pf.hbm_bytes      # layers stream in either way
+    assert pb.flops == pf.flops * 17 / 512   # 2w+1 live columns
+    assert costmodel.banded_cell_ratio("poa", wl_class=512, w=8) == 512 / 17
+    # a band wider than the class floors at the flat bill
+    wide = costmodel.banded_poa_window_cost(8, 512, 10_000, "v2")
+    assert wide.flops == pf.flops
+    assert costmodel.banded_cell_ratio("poa", wl_class=512, w=10_000) == 1.0
+
+
+def test_predict_emits_banded_info_rows_without_double_count():
+    counters = {"align.cells.hirschberg": 10_000_000,
+                "align.cells.banded": 2_500_000,
+                "align.cells.total": 10_000_000,
+                "poa.cells.d8.c128": 1024,
+                "poa.cells.banded": 400_000,
+                "served.consensus.v2": 4}
+    pred = costmodel.predict_from_counters(counters, CPU)
+    banded = [b for b in pred["buckets"] if b["kind"] == "banded"]
+    assert {b["phase"] for b in banded} == {"align", "poa"}
+    # info rows only: phase totals must equal the banded-counter-free run
+    bare = costmodel.predict_from_counters(
+        {k: v for k, v in counters.items() if "banded" not in k}, CPU)
+    assert pred["phases"] == bare["phases"]
 
 
 def test_poa_window_cost_scales_with_depth_and_class():

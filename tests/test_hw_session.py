@@ -161,3 +161,16 @@ def test_session_log_is_appended_jsonl(tmp_path):
     assert any(e.get("step") == "a" for e in lines)
     assert any("session_summary" in e for e in lines)
     assert all("utc" in e for e in lines)
+
+
+def test_strip_progress_collapses_cr_frames():
+    """Tail captures keep only the final frame of \r-overwritten
+    progress bars (both the session and multichip helpers)."""
+    from racon_tpu.tools import multichip
+
+    raw = "start\nbar:  10%\rbar:  55%\rbar: 100%\ndone\n"
+    want = "start\nbar: 100%\ndone\n"
+    assert hw_session._strip_progress(raw) == want
+    assert multichip._strip_progress(raw) == want
+    assert hw_session._strip_progress(None) == ""
+    assert hw_session._strip_progress("plain\nlines") == "plain\nlines"

@@ -126,9 +126,9 @@ def test_polish_correct_at_any_pipeline_depth(tmp_path, monkeypatch, depth):
     submits = []
     real_submit = poa_driver._submit
 
-    def counting_submit(kernel, packed, use_pallas):
+    def counting_submit(kernel, packed, use_pallas, banded=False):
         submits.append(1)
-        return real_submit(kernel, packed, use_pallas)
+        return real_submit(kernel, packed, use_pallas, banded)
 
     monkeypatch.setenv("RACON_TPU_PALLAS", "0")
     # v2 kind: the ls tier rounds the batch up to G*n_dev=64, which would

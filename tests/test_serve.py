@@ -577,6 +577,7 @@ def test_bench_serve_entry_normalizes_as_fixed_point():
                   "Mbp 30x, PAF, w=500, 4 jobs/2 clients)",
         "value": 1.23, "unit": "Mbp/s", "vs_baseline": None,
         "cost_model": None, "pack_split": None, "serial_steps": None,
+        "cells_banded": None, "band_hit_rate": None,
         "serve": {"jobs": 4, "clients": 2,
                   "latency_s": {"p50": 1, "p95": 2, "p99": 3}},
         "mbp": 0.5, "input": "paf", "profile": "serve-ont",
