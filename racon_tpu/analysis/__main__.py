@@ -73,6 +73,15 @@ def main(argv=None) -> int:
                    help="skip the jaxpr audit (AST lint only; fast)")
     p.add_argument("--no-lint", action="store_true",
                    help="skip the AST lint (jaxpr audit only)")
+    p.add_argument("--concurrency", action="store_true",
+                   help="run only the lock-discipline audit "
+                        "(unguarded-mutation / lock-order-cycle); may be "
+                        "combined with --contracts")
+    p.add_argument("--contracts", action="store_true",
+                   help="run only the contract cross-checks (lattice "
+                        "drills/docs, fault-point drills/docs, wire-"
+                        "protocol field agreement); may be combined "
+                        "with --concurrency")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
     p.add_argument("--list-rules", action="store_true",
@@ -99,16 +108,46 @@ def main(argv=None) -> int:
             ("recompile-budget",
              "distinct jit signatures across the kernel grid stay "
              "within the declared budget"),
+            ("unguarded-mutation",
+             "shared state mutated by >=2 thread roles without one "
+             "lock held at every site"),
+            ("lock-order-cycle",
+             "lock-acquisition-order digraph must be acyclic"),
+            ("lattice-drill",
+             "every degradation-lattice edge needs a test drill"),
+            ("lattice-docs",
+             "every degradation-lattice edge needs a failure-modes "
+             "docs row"),
+            ("fault-drill",
+             "every registered fault point needs a test drill"),
+            ("fault-docs",
+             "every registered fault point needs a docs table row"),
+            ("protocol-mismatch",
+             "wire-protocol producers/consumers must agree field-for-"
+             "field with the declared spec"),
         ):
             print(f"{rid:18s} {doc}")
         return 0
 
     root = args.repo_root or lint.repo_root_for()
+    audits_selected = args.concurrency or args.contracts
     violations: List[lint.Violation] = []
-    if not args.no_lint:
-        violations.extend(lint.run_lint(root, paths=args.paths))
-    if not args.no_jaxpr and args.paths is None:
-        violations.extend(jaxpr_audit.run_audit())
+    if not audits_selected:
+        if not args.no_lint:
+            violations.extend(lint.run_lint(root, paths=args.paths))
+        if not args.no_jaxpr and args.paths is None:
+            violations.extend(jaxpr_audit.run_audit())
+    # Concurrency & contract audits: run when selected explicitly, or as
+    # part of a full-tree run (they are whole-repo analyses, so --paths
+    # runs stay lint-only).
+    if args.concurrency or (not audits_selected and not args.no_lint
+                            and args.paths is None):
+        from .concurrency import run_concurrency
+        violations.extend(run_concurrency(root))
+    if args.contracts or (not audits_selected and not args.no_lint
+                          and args.paths is None):
+        from .concurrency import run_contracts
+        violations.extend(run_contracts(root))
 
     baseline_path = args.baseline or os.path.join(
         root, "tools", "lint_baseline.json")

@@ -1,0 +1,41 @@
+"""Static concurrency & contract auditor.
+
+Part A (``--concurrency``): lock discipline.  Discovers thread entry
+points (``threading.Thread(target=...)``), assigns each a *role* from
+the registered role patterns (roles.py), propagates roles through the
+intra-repo call graph, infers which attributes/globals each role
+mutates, and requires every multi-role-mutated location to be guarded by
+a consistently-held lock, be a sanctioned lock-free type (queue.Queue,
+threading.Event, GuardedStats), or carry an explicit
+``# concurrency: <reason>`` waiver.  Also builds the repo-wide
+lock-acquisition-order digraph and fails on cycles.
+
+Part B (``--contracts``): contract extraction.  Statically extracts the
+degradation-lattice edge set, the fault-point name set, and the
+serve/distrib wire-protocol field sets, then cross-checks them against
+the declared specs (serve/protocol.py), the test drills under tests/,
+and the failure-modes rows in docs/.
+
+Both emit ``lint.Violation`` objects so the existing baseline /
+suppression / CLI plumbing applies unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..lint import Violation, repo_root_for
+
+
+def run_concurrency(repo_root: Optional[str] = None) -> List[Violation]:
+    """Run the lock-discipline + lock-order audit over one repo tree."""
+    from .locks import audit
+    root = repo_root or repo_root_for()
+    return audit(root)
+
+
+def run_contracts(repo_root: Optional[str] = None) -> List[Violation]:
+    """Run the lattice/fault/protocol contract cross-checks."""
+    from .contracts import audit
+    root = repo_root or repo_root_for()
+    return audit(root)

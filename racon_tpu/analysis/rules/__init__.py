@@ -46,6 +46,7 @@ from .knobs import EnvRegistryRule, KnobDocsRule  # noqa: E402
 from .faultpoints import FaultPointRule       # noqa: E402
 from .excepts import DeviceExceptRule         # noqa: E402
 from .clock import WallClockRule              # noqa: E402
+from .threads import ThreadsRule              # noqa: E402
 
 #: All rules, in documentation order.
 ALL_RULES = (
@@ -56,6 +57,7 @@ ALL_RULES = (
     FaultPointRule(),
     DeviceExceptRule(),
     WallClockRule(),
+    ThreadsRule(),
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

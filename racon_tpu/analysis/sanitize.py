@@ -273,7 +273,8 @@ def guard_stats(stats: dict, where: str) -> dict:
         faults.check("sanitize.stats")
     except faults.InjectedFault:
         t = threading.Thread(target=g.__setitem__,
-                             args=("_sanitize_stats_probe", 1))
+                             args=("_sanitize_stats_probe", 1),
+                             name="sanitize-stats-probe", daemon=True)
         t.start()
         t.join()
         g.pop("_sanitize_stats_probe", None)

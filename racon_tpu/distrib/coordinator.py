@@ -150,7 +150,10 @@ class Coordinator:
     # -- truth when tracing is disarmed) -----------------------------------
 
     def _count(self, name: str, n: int = 1) -> None:
-        self.counters[name] = self.counters.get(name, 0) + n
+        # Condition wraps an RLock, so this is safe (and cheap) from
+        # call sites that already hold self._cv.
+        with self._cv:
+            self.counters[name] = self.counters.get(name, 0) + n
         obs.count(f"distrib.{name}", n)
 
     # -- setup -------------------------------------------------------------

@@ -28,7 +28,7 @@ _MAX_CAUSES = 20
 _MAX_QUARANTINED = 1000
 
 
-class PhaseReport:
+class PhaseReport:  # concurrency: single-writer accumulator; the coordinator serializes its cross-thread instance under Coordinator._cv
     """Serving/fallback accounting for one phase (alignment/consensus)."""
 
     def __init__(self, phase: str, tiers: Tuple[str, ...]):

@@ -43,3 +43,70 @@ def write_message(f, msg: dict) -> None:
     """Frame and flush one object (the flush is the send)."""
     f.write(json.dumps(msg).encode() + b"\n")
     f.flush()
+
+
+# ---------------------------------------------------------------------------
+# Declared wire-protocol spec.
+#
+# The static contract auditor (racon_tpu/analysis/concurrency/contracts)
+# extracts every producer's sent fields and every consumer's read fields
+# from server.py / client.py / distrib/coordinator.py / distrib/worker.py
+# and cross-checks them against these literals, so the four surfaces
+# cannot drift apart silently.  Keep the dicts pure literals — they are
+# read by `ast.literal_eval`, not imported, when the tree is audited.
+#
+# Shapes: req = fields a request MUST carry; opt = fields it MAY carry;
+# resp = fields an ok-response may carry beyond COMMON_RESP.
+# ---------------------------------------------------------------------------
+
+#: Fields every response may carry regardless of op: the ok flag and
+#: the error envelope the server attaches on any failure path.
+COMMON_RESP = ("ok", "error", "rejected")
+
+PROTOCOL = {
+    "serve": {
+        "ping": {"req": (), "opt": (),
+                 "resp": ("pid", "backend", "port")},
+        "submit": {"req": ("sequences", "overlaps", "target"),
+                   "opt": ("args", "include_unpolished", "backend",
+                           "job_id", "submitter", "window_budget"),
+                   "resp": ("job_id", "lane", "demotions")},
+        "status": {"req": ("job_id",), "opt": (),
+                   "resp": ("job_id", "state", "lane", "submitter",
+                            "demotions", "error", "queued_s",
+                            "running_s")},
+        "result": {"req": ("job_id",), "opt": ("wait", "timeout"),
+                   "resp": ("job_id", "state", "lane", "submitter",
+                            "demotions", "error", "queued_s",
+                            "running_s", "result")},
+        "cancel": {"req": ("job_id",), "opt": (),
+                   "resp": ("job_id", "state", "lane", "submitter",
+                            "demotions", "error", "queued_s",
+                            "running_s")},
+        "stats": {"req": (), "opt": (),
+                  "resp": ("jobs", "queued", "queue_depth", "max_jobs",
+                           "window_budget", "session")},
+        "shutdown": {"req": (), "opt": (), "resp": ("bye",)},
+    },
+    "distrib": {
+        "hello": {"req": ("worker",), "opt": (),
+                  "resp": ("lease_ttl", "heartbeat")},
+        "fetch": {"req": ("worker",), "opt": (),
+                  "resp": ("drain", "wait", "poll_s", "chunk")},
+        "heartbeat": {"req": ("worker", "chunk", "attempt"), "opt": (),
+                      "resp": ("cancel",)},
+        "result": {"req": ("worker", "chunk", "attempt", "output"),
+                   "opt": ("stats",), "resp": ("accepted",)},
+        "error": {"req": ("worker", "chunk", "attempt"),
+                  "opt": ("error",), "resp": ()},
+    },
+}
+
+#: Nested message payloads: "<surface>.<op>.<field>" -> the exact field
+#: set of the nested object.  The producer's literal must match this
+#: set exactly; the consumer may only read declared fields.
+PAYLOADS = {
+    "distrib.fetch.chunk": ("index", "attempt", "sequences", "overlaps",
+                            "target", "args", "include_unpolished",
+                            "backend", "journal", "output"),
+}

@@ -202,15 +202,15 @@ class Scheduler:
                     raise ValueError(f"spec.json holds "
                                      f"{type(doc).__name__}, not an object")
                 spec = JobSpec.from_dict(doc)
-                spec.job_id = job_id
+                spec.job_id = job_id  # concurrency: single-owner until submit() publishes it
                 self.submit(spec)
                 recovered.append(job_id)
             except Exception as e:  # noqa: BLE001 — a torn spec.json can
                 # decode to anything; one damaged job directory must not
                 # take down the restart path
                 job = Job(JobSpec("", "", "", job_id=job_id), job_id)
-                job.state = "failed"
-                job.error = f"recovery failed: {type(e).__name__}: {e}"
+                job.state = "failed"  # concurrency: job is thread-local until published under _cv below
+                job.error = f"recovery failed: {type(e).__name__}: {e}"  # concurrency: thread-local, see above
                 job.done.set()
                 with self._cv:
                     self._jobs[job_id] = job
@@ -394,10 +394,10 @@ class Scheduler:
         """Device-lane failure: re-queue on the host lane (the job-level
         degradation step).  Output stays byte-identical — the host lane
         is the oracle path."""
-        job.demotions.append({
-            "from": "device", "to": "host",
-            "cause": f"{type(exc).__name__}: {exc}"})
         with self._cv:
+            job.demotions.append({
+                "from": "device", "to": "host",
+                "cause": f"{type(exc).__name__}: {exc}"})
             if self._stop:
                 job.state = "queued"   # next daemon life recovers it
                 self._cv.notify_all()

@@ -1,0 +1,4 @@
+"""Seeded fixture: one lattice edge whose docs row exists but whose
+test drill is missing -> exactly one `lattice-drill` finding."""
+
+CONSENSUS_TIERS = ("fast", "slow")

@@ -1,0 +1,6 @@
+# Deliberately not a drill: mentions neither tier of the edge.
+# (Named drills.py, not test_*.py, so pytest never collects it.)
+
+
+def unrelated():
+    return 1

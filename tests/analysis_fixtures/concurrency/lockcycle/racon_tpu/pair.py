@@ -1,0 +1,20 @@
+"""Seeded fixture: two locks acquired in opposite orders on two paths
+-> exactly one `lock-order-cycle` finding."""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                pass

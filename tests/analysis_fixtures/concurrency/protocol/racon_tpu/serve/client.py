@@ -1,0 +1,6 @@
+class Client:
+    def rpc(self, **req) -> dict:
+        return req
+
+    def ping(self) -> dict:
+        return self.rpc(op="ping", extra=1)  # `extra` is undeclared

@@ -1,0 +1,8 @@
+"""Seeded fixture: the client sends a field the declared spec does not
+know -> exactly one `protocol-mismatch` finding."""
+
+PROTOCOL = {
+    "serve": {
+        "ping": {"req": (), "opt": (), "resp": ()},
+    },
+}

@@ -1,0 +1,26 @@
+"""Seeded fixture: one shared location mutated from two roles with no
+lock held at every site -> exactly one `unguarded-mutation` finding."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        self.n = self.n + 1  # unguarded: neither caller holds _lock
+
+
+def loop(c: Counter):
+    c.bump()
+
+
+def main():
+    c = Counter()
+    t = threading.Thread(target=loop, args=(c,), name="serve-conn",
+                         daemon=True)
+    t.start()
+    c.bump()
+    t.join()

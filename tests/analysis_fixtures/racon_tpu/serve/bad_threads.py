@@ -1,0 +1,19 @@
+"""Fixture: every flavor of the thread-discipline rule."""
+
+import threading
+import time
+
+_LOCK = threading.Lock()
+
+
+def spawn():
+    t = threading.Thread(target=print)               # no daemon, no name
+    u = threading.Thread(target=print, daemon=True,
+                         name="mystery-worker")      # unregistered role
+    t.start()
+    u.start()
+
+
+def nap():
+    with _LOCK:
+        time.sleep(0.1)                              # sleep under a lock

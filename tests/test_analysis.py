@@ -29,6 +29,7 @@ FIXTURES = {
     "fault-point": "racon_tpu/ops/bad_fault_point.py",
     "device-except": "racon_tpu/ops/broad_except.py",
     "wall-clock": "racon_tpu/resilience/wall_clock.py",
+    "thread-discipline": "racon_tpu/serve/bad_threads.py",
 }
 
 #: per-file rules (knob-docs is project-level; covered separately)

@@ -333,6 +333,34 @@ def test_align_compile_fault_degrades_to_host(tmp_path, monkeypatch):
     assert al["served"]["host"] == 6 and al["served"]["hirschberg"] == 0
 
 
+def test_poa_compile_fault_degrades_to_host(tmp_path, monkeypatch):
+    """poa.compile.xla: the XLA-twin kernel *build* dies (compile seam,
+    not the run seam); consensus must degrade xla -> host with output
+    still matching the oracle."""
+    paths = _write_dataset(tmp_path)
+    oracle = _oracle(paths)
+    res, p = _tpu_run(paths, monkeypatch,
+                      {"RACON_TPU_FAULT": "poa.compile.xla"})
+    assert res == oracle
+    d = _assert_report_sums(p)
+    cons = d["phases"]["consensus"]
+    assert cons["served"]["host"] == 6 and cons["served"]["xla"] == 0
+    assert any(dg["from"] == "xla" and dg["to"] == "host"
+               for dg in cons["degradations"])
+
+
+def test_native_call_fault_surfaces(tmp_path, monkeypatch):
+    """native.call: the host (native) engine is the lattice floor — an
+    injected fault there has nowhere to degrade to and must surface as
+    the injected exception, not as silent corruption."""
+    paths = _write_dataset(tmp_path)
+    monkeypatch.setenv("RACON_TPU_FAULT", "native.call:count=1")
+    p = racon_tpu.create_polisher(*paths, backend="cpu", **_ARGS)
+    p.initialize()
+    with pytest.raises(faults.InjectedFault):
+        p.polish(True)
+
+
 # ------------------------------------- pallas tiers (single-device subproc)
 
 def test_pallas_chain_ls_v2_xla(tmp_path):
@@ -377,3 +405,47 @@ print("PALLAS-CHAIN-OK", json.dumps(cons["served"]))
                        text=True, timeout=570)
     assert r.returncode == 0, r.stderr[-3000:]
     assert "PALLAS-CHAIN-OK" in r.stdout
+
+
+def test_pallas_compile_faults_chain_to_xla(tmp_path):
+    """poa.compile.ls / poa.compile.v2: both pallas kernel *builds* are
+    killed at the compile seam; the chunk must degrade ls -> v2 -> xla
+    and the output must match the host oracle (compile-seam twins of
+    the run-seam chain above)."""
+    paths = _write_dataset(tmp_path)
+    code = f"""
+import sys
+sys.path.insert(0, {ROOT!r})
+from __graft_entry__ import _force_cpu; _force_cpu(1)
+import json
+import racon_tpu
+
+args = dict(window_length=100, quality_threshold=10, error_threshold=0.3,
+            match=5, mismatch=-4, gap=-8, num_threads=1)
+paths = {paths!r}
+p0 = racon_tpu.create_polisher(*paths, backend="cpu", **args)
+p0.initialize()
+oracle = p0.polish(True)
+
+import os
+os.environ["RACON_TPU_PALLAS"] = "1"
+os.environ["RACON_TPU_POA_KERNEL"] = "ls"
+os.environ["RACON_TPU_BATCH_WINDOWS"] = "8"
+os.environ["RACON_TPU_FAULT"] = "poa.compile.ls,poa.compile.v2"
+p = racon_tpu.create_polisher(*paths, backend="tpu", **args)
+p.initialize()
+res = p.polish(True)
+assert res == oracle, "faulted output diverged from the host oracle"
+d = p.report.as_dict()
+cons = d["phases"]["consensus"]
+assert sum(cons["served"].values()) == cons["total"], cons
+edges = {{(dg["from"], dg["to"]) for dg in cons["degradations"]}}
+assert ("ls", "v2") in edges, edges
+assert ("v2", "xla") in edges, edges
+assert cons["served"]["xla"] == cons["total"], cons
+print("COMPILE-CHAIN-OK", json.dumps(cons["served"]))
+"""
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=570)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "COMPILE-CHAIN-OK" in r.stdout

@@ -68,6 +68,15 @@ run "racon_tpu.analysis (obs focus)" \
         racon_tpu/ops/kernel_cache.py \
         racon_tpu/resilience/report.py
 
+# 1d. Concurrency & contract audits: lock discipline over inferred
+#     thread roles, lock-order acyclicity, lattice/fault-point drill
+#     coverage, wire-protocol field agreement.  (A full-tree run in 1
+#     already includes these; this focused invocation keeps them green
+#     even under --fast / a baselined full run.)
+run "racon_tpu.analysis (concurrency + contracts)" \
+    env JAX_PLATFORMS=cpu python -m racon_tpu.analysis \
+        --concurrency --contracts
+
 # 2. ruff (style + pyflakes), configured in pyproject.toml.
 if command -v ruff >/dev/null 2>&1; then
     run "ruff" ruff check .
