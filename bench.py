@@ -380,6 +380,11 @@ def normalize_entry(e: dict) -> dict:
         e = dict(e)
         e.setdefault("cells_banded", None)
         e.setdefault("band_hit_rate", None)
+    if ("serve" in e or "distrib" in e) and "fleet" not in e:
+        # fleet-lane entries written before the telemetry stamp
+        # (per-worker walls, queueing p95, heartbeat staleness):
+        # explicit null — "not scraped", same as a run with obs off
+        e = dict(e, fleet=None)
     return e
 
 
@@ -701,6 +706,8 @@ def serve_profile(jobs: int = 4, clients: int = 2) -> int:
         "cells_banded": None,
         "band_hit_rate": None,
         "serve": serve_stats,
+        # scraped daemon telemetry (stats-op samples during the run)
+        "fleet": summary.get("daemon_stats"),
         **({"device_status": "unreachable"} if degraded else {}),
     }
     assert normalize_entry(dict(entry)) == entry, \
@@ -709,7 +716,8 @@ def serve_profile(jobs: int = 4, clients: int = 2) -> int:
         "mbp": MBP, "input": INPUT, "profile": f"serve-{PROFILE}",
         "value": round(value, 4), "vs_baseline": None,
         "kernel": config.get_str("RACON_TPU_POA_KERNEL") or "ls",
-        "serve": serve_stats, "cost_model": None, "pack_split": None,
+        "serve": serve_stats, "fleet": summary.get("daemon_stats"),
+        "cost_model": None, "pack_split": None,
         "serial_steps": None,
         **({"device_status": "unreachable"} if degraded else {}),
     })
@@ -782,6 +790,9 @@ def distrib_profile(workers: int = 3) -> int:
         "cells_banded": None,
         "band_hit_rate": None,
         "distrib": distrib_stats,
+        # fleet telemetry from the coordinator: per-worker chunk/kernel
+        # walls, dispatch-queue wait p95, heartbeat staleness max
+        "fleet": result.get("telemetry"),
     }
     assert normalize_entry(dict(entry)) == entry, \
         "distrib bench entry must be a normalize_entry fixed point"
@@ -789,6 +800,7 @@ def distrib_profile(workers: int = 3) -> int:
         "mbp": MBP, "input": INPUT, "profile": f"distrib-{PROFILE}",
         "value": round(value, 4), "vs_baseline": None,
         "kernel": "host", "distrib": distrib_stats,
+        "fleet": result.get("telemetry"),
         "cost_model": None, "pack_split": None, "serial_steps": None,
     })
     print(json.dumps(entry))

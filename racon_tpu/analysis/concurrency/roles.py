@@ -33,6 +33,8 @@ THREAD_ROLE_PATTERNS = {
     "align-worker": "pipelined-phases alignment feeder (polisher.py)",
     "racon-tpu-watchdog-call": "device-call watchdog runner",
     "loadtest-c*": "serve load-test client thread (serve/loadtest.py)",
+    "loadtest-stats": "load-test daemon telemetry poller "
+                      "(serve/loadtest.py)",
     "sanitize-stats-probe": "sanitizer cross-thread stats probe",
 }
 

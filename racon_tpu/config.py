@@ -148,6 +148,20 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
     _k("RACON_TPU_MACHINE_PROFILE", "auto", "str",
        "machine profile for cost-model predictions: auto | cpu-host | "
        "tpu-v4-lite (auto picks by backend platform)"),
+    _k("RACON_TPU_FLIGHT", "1", "bool",
+       "always-on crash flight recorder: ring of the last N spans/events "
+       "per process, dumped to the job dir on faults, TierDead, worker "
+       "crash, or SIGTERM (0 disables; see obs/flight.py)"),
+    _k("RACON_TPU_FLIGHT_EVENTS", "256", "int",
+       "flight-recorder ring capacity: most-recent events kept per "
+       "process for the post-mortem dump"),
+    _k("RACON_TPU_OBS_SHIP_EVENTS", "1500", "int",
+       "span-shipping cap: trace events a distrib worker / serve job "
+       "returns with each result for the merged fleet timeline (bounded "
+       "so shipments fit the wire's line limit)"),
+    _k("RACON_TPU_TELEMETRY_RING", "64", "int",
+       "live-telemetry ring capacity: periodic metrics snapshots kept "
+       "per process, scraped through the serve/distrib 'stats' verb"),
     # -- serving knobs ----------------------------------------------------
     _k("RACON_TPU_SERVE_PORT", "0", "int",
        "TCP port for the `racon-tpu serve` daemon (0 = pick a free "

@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import sys
 import tempfile
 
@@ -77,6 +78,16 @@ def main(argv=None) -> int:
 
     workdir = args.state_dir or tempfile.mkdtemp(prefix="racon-distrib-")
     out_path = args.output or os.path.join(workdir, "polished.fasta")
+
+    from ..obs import flight
+
+    def _on_sigterm(signum, frame):
+        # post-mortem before the default die: the coordinator's ring
+        # lands next to the worker dumps it would have swept
+        flight.dump("sigterm", dir_path=workdir, signal=int(signum))
+        raise SystemExit(143)
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     coord = Coordinator(
         args.sequences, args.overlaps, args.targets, workdir,
         args={

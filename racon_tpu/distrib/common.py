@@ -5,6 +5,7 @@ and the blocking request/response helper over the serve wire format
 
 from __future__ import annotations
 
+import socket
 from typing import Optional
 
 from .. import config
@@ -26,6 +27,16 @@ def rpc(f, msg: dict) -> dict:
     if not resp.get("ok"):
         raise WireError(str(resp.get("error", "request failed")))
     return resp
+
+
+def fleet_stats(port: int, host: str = "127.0.0.1",
+                timeout: float = 5.0) -> dict:
+    """One-shot live-telemetry scrape of a running coordinator: open a
+    connection, issue the ``stats`` op, close.  Raises WireError/OSError
+    when the coordinator is unreachable."""
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        with sock.makefile("rwb") as f:
+            return rpc(f, {"op": "stats"})
 
 
 def distrib_workers() -> int:

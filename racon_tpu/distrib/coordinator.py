@@ -51,6 +51,7 @@ import time
 from typing import Dict, List, Optional
 
 from .. import obs
+from ..obs import context, flight
 from ..polisher import _split_fasta
 from ..resilience import faults
 from ..resilience.report import PhaseReport, RunReport
@@ -72,7 +73,8 @@ TIERS = ("fleet", "local")
 
 
 class Lease:
-    __slots__ = ("worker", "attempt", "deadline", "t_start", "canonical")
+    __slots__ = ("worker", "attempt", "deadline", "t_start", "canonical",
+                 "last_beat")
 
     def __init__(self, worker: int, attempt: int, ttl: float,
                  canonical: bool):
@@ -81,6 +83,7 @@ class Lease:
         self.t_start = time.monotonic()
         self.deadline = self.t_start + ttl
         self.canonical = canonical   # holds the chunk's primary journal
+        self.last_beat = self.t_start   # heartbeat-staleness telemetry
 
 
 class Chunk:
@@ -102,6 +105,7 @@ class Chunk:
         self.output: Optional[str] = None
         self.stats: dict = {}
         self.served_by: Optional[str] = None
+        self.t_pending = time.monotonic()   # queue-wait telemetry
 
 
 class Coordinator:
@@ -134,6 +138,11 @@ class Coordinator:
         self.chunks: List[Chunk] = []
         self.counters: Dict[str, int] = {}
         self.completed_walls: List[float] = []
+        self.queue_waits: List[float] = []      # eligible→dispatch, s
+        self.worker_stats: Dict[int, dict] = {} # per-worker aggregates
+        self._staleness_max = 0.0               # worst heartbeat gap, s
+        self._ctx: Optional[dict] = None        # fleet trace context
+        self._last_tick = 0.0
         self.report = RunReport()
         self.phase = PhaseReport("distrib", TIERS)
         self.report.attach(self.phase)
@@ -275,6 +284,8 @@ class Coordinator:
             return self._result(req)
         if op == "error":
             return self._chunk_error(req)
+        if op == "stats":
+            return self._stats()
         raise ValueError(f"unknown op {op!r}")
 
     # -- assignment ---------------------------------------------------------
@@ -333,14 +344,22 @@ class Coordinator:
             journal = os.path.join(c.dir, f"journal.a{attempt}.jsonl")
         c.leases[attempt] = Lease(worker, attempt, self.lease_ttl,
                                   canonical)
+        self.queue_waits.append(max(
+            0.0, time.monotonic() - max(c.t_pending, c.next_eligible)))
         self._count("dispatches")
         if speculative:
             self._count("speculative")
         if attempt > 1 and not speculative:
             self._count("redispatches")
+        # trace-context propagation: each dispatch gets a fresh span id;
+        # the worker stamps it as `parent` on its distrib.chunk span, so
+        # the merged timeline parents worker spans under this event
+        ctx = context.child(self._ctx)
         obs.event("distrib.dispatch", chunk=c.index, worker=worker,
                   attempt=attempt, speculative=speculative,
-                  canonical_journal=canonical)
+                  canonical_journal=canonical,
+                  trace_id=(ctx or {}).get("trace_id"),
+                  span_id=(ctx or {}).get("parent"))
         return {"ok": True, "chunk": {
             "index": c.index, "attempt": attempt,
             "sequences": self.sequences, "overlaps": self.overlaps,
@@ -348,6 +367,7 @@ class Coordinator:
             "include_unpolished": self.include_unpolished,
             "backend": self.backend, "journal": journal,
             "output": os.path.join(c.dir, f"out.a{attempt}.fasta"),
+            "trace": ctx,
         }}
 
     # -- worker messages ----------------------------------------------------
@@ -360,7 +380,11 @@ class Coordinator:
                 # the attempt was superseded (lease expired and the
                 # chunk re-dispatched, or another attempt won)
                 return {"ok": True, "cancel": True}
-            lease.deadline = time.monotonic() + self.lease_ttl
+            now = time.monotonic()
+            self._staleness_max = max(self._staleness_max,
+                                      now - lease.last_beat)
+            lease.last_beat = now
+            lease.deadline = now + self.lease_ttl
             self._count("heartbeats")
             return {"ok": True, "cancel": False}
 
@@ -392,9 +416,24 @@ class Coordinator:
             if replayed:
                 self._count("journal_replayed", replayed)
             self._count("chunks_fleet")
+            ws = self.worker_stats.setdefault(
+                int(req["worker"]),
+                {"chunks": 0, "wall_s": 0.0, "kernel_wall_s": 0.0})
+            ws["chunks"] += 1
+            ws["wall_s"] = round(
+                ws["wall_s"] + float(stats.get("wall_s") or 0.0), 4)
+            ws["kernel_wall_s"] = round(
+                ws["kernel_wall_s"]
+                + float(stats.get("kernel_wall_s") or 0.0), 4)
             obs.event("distrib.chunk_done", chunk=index,
                       worker=int(req["worker"]), attempt=attempt,
                       replayed=replayed)
+            # fold the worker's shipped span buffer + metrics into the
+            # coordinator's tracer: the written trace IS the merged
+            # multi-process fleet timeline
+            absorbed = obs.absorb(req.get("obs"))
+            if absorbed:
+                self._count("obs_events_absorbed", absorbed)
             self._cv.notify_all()
             return {"ok": True, "accepted": True}
 
@@ -415,6 +454,50 @@ class Coordinator:
                       worker=int(req["worker"]), attempt=attempt,
                       error=err)
             return {"ok": True}
+
+    def _stats(self) -> dict:
+        """The deepened 'stats' wire verb: live fleet telemetry for a
+        poller (queue depth, in-flight leases, per-tier served,
+        heartbeat staleness) plus the recent telemetry ring."""
+        with self._cv:
+            now = time.monotonic()
+            states = {"pending": 0, "running": 0, "done": 0}
+            for c in self.chunks:
+                states[c.state] = states.get(c.state, 0) + 1
+            leases = sum(len(c.leases) for c in self.chunks)
+            staleness = 0.0
+            for c in self.chunks:
+                for ls in c.leases.values():
+                    staleness = max(staleness, now - ls.last_beat)
+            self._staleness_max = max(self._staleness_max, staleness)
+            return {"ok": True,
+                    "chunks": states,
+                    "leases": leases,
+                    "workers": {"live": self._live_workers(),
+                                "dead": len(self._dead_workers)},
+                    "served": dict(self.phase.served),
+                    "staleness_s": round(staleness, 3),
+                    "counters": dict(self.counters),
+                    "telemetry": obs.telemetry(last=8)}
+
+    def _queueing_p95(self) -> Optional[float]:
+        """p95 of the eligible→dispatch queue waits (None before the
+        first dispatch) — the bench telemetry stamp."""
+        waits = sorted(self.queue_waits)
+        if not waits:
+            return None
+        return round(waits[min(len(waits) - 1,
+                               int(0.95 * len(waits)))], 4)
+
+    def fleet_telemetry(self) -> dict:
+        """The per-run fleet telemetry summary stamped into the run
+        result and bench entries."""
+        return {
+            "workers": {str(w): dict(s)
+                        for w, s in sorted(self.worker_stats.items())},
+            "queueing_p95_s": self._queueing_p95(),
+            "staleness_max_s": round(self._staleness_max, 3),
+        }
 
     # -- failure paths (call with the lock held) ----------------------------
 
@@ -551,40 +634,60 @@ class Coordinator:
     def run(self, output_path: str,
             timeout: Optional[float] = None) -> dict:
         obs.reset()
+        obs.set_role("coordinator")
+        # fleet trace context: minted fresh per run, activated before
+        # configure so the tracer stamps it into the file's provenance;
+        # _assign derives one child context per dispatch from it
+        context.activate(context.fresh())
         obs.configure(trace_path=self.trace_path)
+        self._ctx = context.current() if obs.enabled() else None
         faults.reset()
         os.makedirs(self.workdir, exist_ok=True)
+        flight.set_dir(self.workdir)
         deadline = (None if not timeout
                     else time.monotonic() + timeout)
-        with obs.span("distrib.run", workers=self.n_workers,
-                      backend=self.backend):
-            self._layout()
-            self._listen()
-            self._spawn_fleet()
-            try:
-                self._monitor(deadline)
-            finally:
-                self._shutdown_fleet()
-            self._gather(output_path)
-        self.report.finalize()
-        self.phase.extra.update(self.counters)
-        if self.report_path:
-            self.report.write(self.report_path)
-        self.report.write_env()
-        obs.write_trace()
-        replayed = self.counters.get("journal_replayed", 0)
-        return {
-            "output": output_path,
-            "chunks": len(self.chunks),
-            "workers": self.n_workers,
-            "served": dict(self.phase.served),
-            "degradations": list(self.phase.degradations),
-            "counters": dict(self.counters),
-            "journal_replayed": replayed,
-            "report": self.report_path,
-            "trace": self.trace_path,
-            "summary": self.report.summary(),
-        }
+        try:
+            with obs.span("distrib.run", workers=self.n_workers,
+                          backend=self.backend):
+                self._layout()
+                self._listen()
+                self._spawn_fleet()
+                try:
+                    self._monitor(deadline)
+                finally:
+                    self._shutdown_fleet()
+                self._gather(output_path)
+            self.report.finalize()
+            # post-mortem sweep: any flight.<pid>.json a crashed/killed
+            # worker left in a chunk dir is referenced from the report
+            self.report.flight = flight.scan(self.workdir)
+            if self.report.flight:
+                self._count("flight_dumps", len(self.report.flight))
+            self.phase.extra.update(self.counters)
+            if self.report_path:
+                self.report.write(self.report_path)
+            self.report.write_env()
+            replayed = self.counters.get("journal_replayed", 0)
+            return {
+                "output": output_path,
+                "chunks": len(self.chunks),
+                "workers": self.n_workers,
+                "served": dict(self.phase.served),
+                "degradations": list(self.phase.degradations),
+                "counters": dict(self.counters),
+                "journal_replayed": replayed,
+                "report": self.report_path,
+                "trace": self.trace_path,
+                "telemetry": self.fleet_telemetry(),
+                "flight": [d.get("path") for d in self.report.flight],
+                "summary": self.report.summary(),
+            }
+        finally:
+            # scoped teardown: write the merged trace, then disarm the
+            # process-global tracer and trace context so a second
+            # in-process run can never append into this run's file
+            obs.release(write=True)
+            context.clear()
 
     def _monitor(self, deadline: Optional[float]) -> None:
         while True:
@@ -602,6 +705,21 @@ class Coordinator:
                 if p.poll() is not None and i not in self._dead_workers:
                     self._worker_dead(i, f"exited {p.returncode}")
             self._expire_leases()
+            now = time.monotonic()
+            if now - self._last_tick >= 1.0:
+                self._last_tick = now
+                with self._cv:
+                    staleness = max(
+                        (now - ls.last_beat for c in self.chunks
+                         for ls in c.leases.values()), default=0.0)
+                    self._staleness_max = max(self._staleness_max,
+                                              staleness)
+                    obs.telemetry_tick(
+                        queue_depth=sum(1 for c in self.chunks
+                                        if c.state == "pending"),
+                        leases=sum(len(c.leases) for c in self.chunks),
+                        workers_live=self._live_workers(),
+                        staleness_s=round(staleness, 3))
             local_work = []
             with self._cv:
                 live = self._live_workers()

@@ -30,11 +30,14 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal
 import socket
 import sys
 import threading
 import time
 
+from .. import obs
+from ..obs import context, flight
 from .common import WireError, rpc
 
 
@@ -43,13 +46,27 @@ def _polish_chunk(a: dict) -> dict:
     from ..polisher import create_polisher
 
     t0 = time.monotonic()
+    chunk_dir = os.path.dirname(a["output"]) or "."
+    # trace-context propagation: the coordinator's dispatch shipped a
+    # {trace_id, parent} pair when it is tracing; activating it BEFORE
+    # create_polisher matters because the polisher's reset_run_state
+    # re-arms obs, and the fresh tracer stamps the active context.
+    # A flight dump from this chunk lands in the chunk directory.
+    ctx = a.get("trace")
+    context.activate(ctx)
+    flight.set_dir(chunk_dir)
+    trace_path = (os.path.join(chunk_dir, f"trace.a{a['attempt']}.json")
+                  if ctx else None)
     polisher = create_polisher(
         a["sequences"], a["overlaps"], a["target"],
         backend=a.get("backend") or "cpu",
         journal_path=a["journal"], resume_journal=True,
-        trace_path=None, **(a.get("args") or {}))
-    polisher.initialize()
-    out = polisher.polish(not a.get("include_unpolished"))
+        trace_path=trace_path, **(a.get("args") or {}))
+    with obs.span("distrib.chunk", chunk=a["index"], attempt=a["attempt"],
+                  trace_id=(ctx or {}).get("trace_id"),
+                  parent=(ctx or {}).get("parent")):
+        polisher.initialize()
+        out = polisher.polish(not a.get("include_unpolished"))
     part = a["output"] + ".part"
     with open(part, "w") as f:
         for name, data in out:
@@ -57,11 +74,18 @@ def _polish_chunk(a: dict) -> dict:
     os.replace(part, a["output"])
     replayed = sum(rep.served.get("journal", 0)
                    for rep in polisher.report.phases.values())
+    # kernel wall: tier-attributed serving wall of the two DP phases —
+    # the per-worker number the fleet breakdown and bench telemetry use
+    kernel_wall = sum(
+        sum(rep.wall_s.values())
+        for name, rep in polisher.report.phases.items()
+        if name in ("alignment", "consensus"))
     return {
         "wall_s": round(time.monotonic() - t0, 4),
         "records": len(out),
         "polished_bp": sum(len(data) for _, data in out),
         "journal_replayed": replayed,
+        "kernel_wall_s": round(kernel_wall, 4),
     }
 
 
@@ -116,19 +140,32 @@ def run_worker(port: int, worker: int, poll_s: float = 0.2) -> int:
             # reported and the worker lives on to fetch the next one
             stop.set()
             hb.join()
+            flight.dump("chunk_error", chunk=a["index"],
+                        attempt=a["attempt"],
+                        error=f"{type(e).__name__}: {e}")
+            obs.release(write=False)
             rpc(main_f, {"op": "error", "worker": worker,
                          "chunk": a["index"], "attempt": a["attempt"],
                          "error": f"{type(e).__name__}: {e}"})
             continue
         stop.set()
         hb.join()
+        # ship this chunk's span buffer + metrics snapshot with the
+        # result (None when tracing is disarmed — the field stays off
+        # the wire), then scope the per-chunk tracer out so the next
+        # chunk cannot append into this chunk's trace file
+        ship = obs.shipment()
+        obs.release(write=True)
         # the chaos seam: the chunk is fully journaled and its output
         # written, but the result is not yet delivered — kill=1 here is
         # the canonical mid-chunk crash the resume path must absorb
         faults.check("worker.result")
-        rpc(main_f, {"op": "result", "worker": worker,
-                     "chunk": a["index"], "attempt": a["attempt"],
-                     "output": a["output"], "stats": stats})
+        msg = {"op": "result", "worker": worker,
+               "chunk": a["index"], "attempt": a["attempt"],
+               "output": a["output"], "stats": stats}
+        if ship is not None:
+            msg["obs"] = ship
+        rpc(main_f, msg)
         chunks_done += 1
     for f, s in ((main_f, main_sock), (hb_f, hb_sock)):
         try:
@@ -150,6 +187,15 @@ def main(argv=None) -> int:
     p.add_argument("--worker", type=int, required=True,
                    help="this worker's index in the fleet")
     args = p.parse_args(argv)
+    obs.set_role(f"worker{args.worker}")
+
+    def _on_sigterm(signum, frame):
+        # post-mortem before dying: the ring of recent spans/events
+        # lands in the current chunk directory (set per fetch)
+        flight.dump("sigterm", signal=int(signum))
+        raise SystemExit(143)
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     try:
         done = run_worker(args.port, args.worker)
     except WireError as e:

@@ -27,17 +27,22 @@ lazily and only when armed on a TPU backend.
 
 from __future__ import annotations
 
+import collections
 import sys
 import threading
+import time
 from typing import Optional
 
 from .. import config
+from . import context, flight
 from .metrics import Metrics
 from .tracer import NULL_SPAN, Span, Tracer
 
 ENV_TRACE = "RACON_TPU_TRACE"
 ENV_METRICS = "RACON_TPU_METRICS"
 ENV_TRACE_DEVICE = "RACON_TPU_TRACE_DEVICE"
+ENV_SHIP_EVENTS = "RACON_TPU_OBS_SHIP_EVENTS"
+ENV_TELEMETRY_RING = "RACON_TPU_TELEMETRY_RING"
 
 #: The five pipeline phases every polish decomposes into, in execution
 #: order.  Span names are ``phase.<name>``; the CLI breakdown and the
@@ -50,13 +55,26 @@ _metrics: Optional[Metrics] = None
 _trace_path: Optional[str] = None
 _device_tracing = False
 
+# Process role ("coordinator", "worker0", "serve", …) for the merged
+# fleet timeline.  Survives reset() on purpose: a process keeps its
+# identity across every run it hosts, exactly like its pid.
+_role: Optional[str] = None
+
+# Live-telemetry ring: periodic gauge snapshots (queue depth, in-flight
+# leases, …) scraped through the serve/distrib 'stats' wire verb.
+# Survives reset() — it is per-process history, not per-run state.
+_telemetry_lock = threading.Lock()
+_telemetry = None
+
 
 # -- arming ----------------------------------------------------------------
 
 def reset() -> None:
     """Disarm and drop all collected state (called per run by the
     polisher constructors, before ``configure``).  A device trace left
-    running by a crashed run is stopped first."""
+    running by a crashed run is stopped first.  The flight recorder,
+    process role, trace context, and telemetry ring survive — they are
+    process identity/history, not per-run trace state."""
     global _tracer, _metrics, _trace_path
     maybe_stop_device_trace()
     with _lock:
@@ -71,7 +89,15 @@ def configure(trace_path: Optional[str] = None,
     falls back to the ``RACON_TPU_TRACE`` / ``RACON_TPU_METRICS`` knobs.
     Tracing implies metrics (the snapshot rides inside the trace file);
     ``RACON_TPU_METRICS=1`` alone collects spans + counters in memory for
-    the ``RunReport["obs"]`` snapshot without writing a trace file."""
+    the ``RunReport["obs"]`` snapshot without writing a trace file.
+
+    Idempotent per destination: re-arming with the trace path already
+    armed keeps the collected spans (the serve session re-enters
+    ``reset``/``configure`` per job; the distrib coordinator arms once
+    per ``run()``).  Arming a *different* path swaps in a fresh tracer,
+    so a second in-process run can never append spans into the previous
+    run's file — the scoped teardown (``release()``) plus this check is
+    the regression surface tests/test_obs.py pins."""
     global _tracer, _metrics, _trace_path
     if trace_path is None:
         trace_path = config.get_str(ENV_TRACE) or None
@@ -80,19 +106,63 @@ def configure(trace_path: Optional[str] = None,
     if not trace_path and not metrics:
         return
     with _lock:
+        if _tracer is not None and _trace_path == trace_path:
+            return
         _trace_path = trace_path
         _tracer = Tracer()
         _metrics = Metrics()
+        _tracer.role = _role
+        ctx = context.current()
+        if ctx is not None:
+            _tracer.trace_id = ctx.get("trace_id")
+            _tracer.parent_span = ctx.get("parent")
         # every finished span also lands in a span_us.<name> log2
         # histogram, so the CLI breakdown gets p50/p99 per span name
-        # even when the bounded event buffer truncated the timeline
+        # even when the bounded event buffer truncated the timeline —
+        # and in the flight-recorder ring, so a crash dump carries the
+        # span tail too
         m = _metrics
-        _tracer.on_complete = \
-            lambda name, dur_us: m.observe(f"span_us.{name}", dur_us)
+        fl = flight.recorder()
+        def _on_complete(name, dur_us, _m=m, _fl=fl):
+            _m.observe(f"span_us.{name}", dur_us)
+            _fl.span(name, dur_us)
+        _tracer.on_complete = _on_complete
+
+
+def release(write: bool = True) -> Optional[str]:
+    """Scoped teardown of one armed run: optionally write the trace,
+    then disarm.  The multi-run surfaces (distrib coordinator, serve
+    scheduler) call this in a ``finally`` so the process-global tracer
+    never outlives the run that armed it."""
+    path = write_trace() if write else None
+    reset()
+    return path
+
+
+def set_role(role: Optional[str]) -> None:
+    """Name this process's track in merged fleet timelines and flight
+    dumps ("coordinator", "worker0", "serve", …).  Sticky across
+    ``reset()``."""
+    global _role
+    _role = role
+    flight.set_role(role)
+    t = _tracer
+    if t is not None:
+        t.role = role
+
+
+def role() -> Optional[str]:
+    return _role
 
 
 def enabled() -> bool:
     return _tracer is not None
+
+
+def tracer() -> Optional[Tracer]:
+    """The armed tracer, or None — read-only introspection for tests
+    and tools; mutation goes through the hooks below."""
+    return _tracer
 
 
 def trace_path() -> Optional[str]:
@@ -111,7 +181,11 @@ def span(name: str, **args):
 
 
 def event(name: str, **args) -> None:
-    """Instant event (lattice demotion, watchdog timeout, …)."""
+    """Instant event (lattice demotion, watchdog timeout, …).  Always
+    breadcrumbed into the flight recorder — instant events are exactly
+    the rare, high-signal moments a post-mortem needs — and additionally
+    recorded on the tracer timeline when armed."""
+    flight.record(name, **args)
     t = _tracer
     if t is not None:
         t.add_instant(name, **args)
@@ -135,6 +209,62 @@ def observe(name: str, value: float) -> None:
     m = _metrics
     if m is not None:
         m.observe(name, value)
+
+
+# -- cross-process span shipping -------------------------------------------
+
+def shipment(max_events: Optional[int] = None) -> Optional[dict]:
+    """Bounded, JSON-ready export of this process's span buffer +
+    metrics snapshot, shipped with a distrib chunk / serve job result so
+    the coordinator can fold it into the merged fleet trace.  None when
+    disarmed — a disarmed worker ships nothing and the wire field stays
+    absent."""
+    t = _tracer
+    if t is None:
+        return None
+    if max_events is None:
+        max_events = max(1, config.get_int(ENV_SHIP_EVENTS))
+    return t.export(max_events=max_events, metrics=snapshot())
+
+
+def absorb(ship) -> int:
+    """Fold a peer process's ``shipment()`` into this process's armed
+    tracer (timestamps re-based, pid tracks preserved).  No-op when
+    disarmed or the shipment is absent/malformed; returns the number of
+    events absorbed."""
+    t = _tracer
+    if t is None or not isinstance(ship, dict):
+        return 0
+    return t.ingest(ship)
+
+
+# -- live telemetry ----------------------------------------------------------
+
+def telemetry_tick(**gauges) -> dict:
+    """Append one gauge snapshot (queue depth, in-flight leases, …) to
+    the process's bounded telemetry ring and return it.  Armed or not —
+    telemetry is scrape-state for the 'stats' wire verb, not trace
+    output — but when metrics are armed the per-phase served totals ride
+    along so a poller watches serving progress live."""
+    global _telemetry
+    entry = {"t_mono_ns": time.monotonic_ns()}
+    entry.update(gauges)
+    m = _metrics
+    if m is not None:
+        entry["served_total"] = m.prefix_sum("served.")
+    with _telemetry_lock:
+        if _telemetry is None:
+            _telemetry = collections.deque(
+                maxlen=max(1, config.get_int(ENV_TELEMETRY_RING)))
+        _telemetry.append(entry)
+    return entry
+
+
+def telemetry(last: Optional[int] = None) -> list:
+    """The telemetry ring, oldest first (optionally just the last N)."""
+    with _telemetry_lock:
+        items = [] if _telemetry is None else list(_telemetry)
+    return items[-last:] if last else items
 
 
 # -- snapshots & invariants ------------------------------------------------

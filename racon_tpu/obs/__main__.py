@@ -13,12 +13,15 @@ Subcommands (the cost-model surface, same exit-code contract)::
     python -m racon_tpu.obs model [--profile P] [--lowered]
     python -m racon_tpu.obs validate run.json [--profile P]
     python -m racon_tpu.obs bench [extra.json ...] [--threshold T]
+    python -m racon_tpu.obs merge --out MERGED.json T1.json T2.json ...
+    python -m racon_tpu.obs fleet MERGED.json [--json]
 
 Exit codes (CI keys off these):
 
 * 0 — trace valid / prediction within the profile's declared bound /
   no bench regression
-* 1 — schema violation(s) in an otherwise readable trace
+* 1 — schema violation(s) in an otherwise readable trace, or a
+  ``fleet`` trace-context violation (dangling parent / mixed trace ids)
 * 2 — file unreadable / not JSON / not a trace object / bad arguments
 * 3 — regression: ``--diff`` phase regression past ``--threshold``,
   ``validate`` prediction error past the machine profile's declared
@@ -311,6 +314,195 @@ def cmd_bench(args) -> int:
     return 3 if result["regressions"] else 0
 
 
+def _doc_t0_ns(doc: dict):
+    od = doc.get("otherData")
+    if isinstance(od, dict):
+        t0 = od.get("t0_monotonic_ns")
+        if isinstance(t0, int):
+            return t0
+    return None
+
+
+def merge_traces(docs: List[dict], paths: List[str]) -> dict:
+    """Fold per-process trace documents into one multi-track timeline.
+
+    Same-host traces share the monotonic clock, so each document's
+    events shift by the µs offset of its ``t0_monotonic_ns`` epoch from
+    the earliest one — dispatch spans in the coordinator then line up
+    against the worker chunk spans they caused.  Documents without an
+    epoch stamp (older traces) keep their own timebase.  pid/tid stamps
+    are preserved: one Perfetto track group per process, named by the
+    ``process_name`` metadata each document already carries."""
+    t0s = [_doc_t0_ns(d) for d in docs]
+    known = [t for t in t0s if t is not None]
+    base = min(known) if known else None
+    events: List[dict] = []
+    processes: List[dict] = []
+    dropped = 0
+    for doc, path, t0 in zip(docs, paths, t0s):
+        dt_us = ((t0 - base) // 1000) if (t0 is not None
+                                          and base is not None) else 0
+        for ev in doc.get("traceEvents", []):
+            if not isinstance(ev, dict):
+                continue
+            ev = dict(ev)
+            if ev.get("ph") != "M" and isinstance(ev.get("ts"),
+                                                  (int, float)):
+                ev["ts"] = max(0, int(ev["ts"]) + dt_us)
+            events.append(ev)
+        dropped += dropped_events(doc)
+        od = doc.get("otherData") if isinstance(doc.get("otherData"),
+                                                dict) else {}
+        processes.append({
+            "path": path, "pid": od.get("pid"), "role": od.get("role"),
+            "trace_id": od.get("trace_id"), "t0_monotonic_ns": t0,
+            "offset_us": dt_us, "events": len(doc.get("traceEvents", [])),
+        })
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"tool": "racon_tpu.obs", "clock": "monotonic",
+                      "dropped_events": dropped,
+                      "merged_from": list(paths)},
+        "racon_tpu": {"processes": processes},
+    }
+
+
+def cmd_merge(args) -> int:
+    docs = []
+    for path in args.traces:
+        try:
+            doc, errors = load_trace(path)
+        except (OSError, ValueError) as e:
+            print(f"[obs] cannot read trace {path}: {e}", file=sys.stderr)
+            return 2
+        if errors:
+            for err in errors:
+                print(f"[obs] {path}: {err}", file=sys.stderr)
+            return 1
+        docs.append(doc)
+    merged = merge_traces(docs, args.traces)
+    try:
+        with open(args.out, "w") as f:
+            json.dump(merged, f)
+            f.write("\n")
+    except OSError as e:
+        print(f"[obs] cannot write {args.out}: {e}", file=sys.stderr)
+        return 2
+    procs = merged["racon_tpu"]["processes"]
+    print(f"[obs] merged {len(docs)} trace(s), "
+          f"{len(merged['traceEvents'])} events, "
+          f"{len(procs)} process entr{'y' if len(procs) == 1 else 'ies'} "
+          f"-> {args.out}")
+    return 0
+
+
+def fleet_breakdown(doc: dict) -> dict:
+    """Per-process accounting over a merged fleet trace, plus the
+    trace-context invariants the merge exists to make checkable:
+
+    * every ``distrib.chunk`` span naming a parent must name the
+      ``span_id`` of some coordinator ``distrib.dispatch`` event
+      (dangling parent = causality lost in the merge);
+    * every ``trace_id`` stamped on chunks/dispatches must match — one
+      fleet run is one trace.
+    """
+    roles: Dict[int, str] = {}
+    per: Dict[int, dict] = {}
+    dispatch_ids = set()
+    trace_ids = set()
+    violations: List[str] = []
+    for ev in doc.get("traceEvents", []):
+        if not isinstance(ev, dict):
+            continue
+        pid = ev.get("pid")
+        if not isinstance(pid, int):
+            continue
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            name = (ev.get("args") or {}).get("name")
+            if isinstance(name, str):
+                roles[pid] = name
+            continue
+        p = per.setdefault(pid, {"spans": 0, "events": 0, "chunks": 0,
+                                 "dispatches": 0, "chunk_wall_us": 0,
+                                 "kernel_wall_us": 0})
+        args = ev.get("args") if isinstance(ev.get("args"), dict) else {}
+        name = ev.get("name", "")
+        if ev.get("ph") == "X":
+            p["spans"] += 1
+            dur = int(ev.get("dur", 0))
+            if name == "distrib.chunk":
+                p["chunks"] += 1
+                p["chunk_wall_us"] += dur
+                if args.get("trace_id"):
+                    trace_ids.add(args["trace_id"])
+            elif name in ("phase.align", "phase.poa"):
+                # the two hot-kernel phases (obs.PHASES naming)
+                p["kernel_wall_us"] += dur
+        elif ev.get("ph") in ("i", "I"):
+            p["events"] += 1
+            if name == "distrib.dispatch":
+                p["dispatches"] += 1
+                if args.get("span_id"):
+                    dispatch_ids.add(args["span_id"])
+                if args.get("trace_id"):
+                    trace_ids.add(args["trace_id"])
+    # second pass: parenting — a chunk span's parent must be a dispatch
+    for ev in doc.get("traceEvents", []):
+        if not (isinstance(ev, dict) and ev.get("ph") == "X"
+                and ev.get("name") == "distrib.chunk"):
+            continue
+        args = ev.get("args") if isinstance(ev.get("args"), dict) else {}
+        parent = args.get("parent")
+        if parent and parent not in dispatch_ids:
+            violations.append(
+                f"distrib.chunk (pid {ev.get('pid')}, chunk "
+                f"{args.get('chunk')}) names parent {parent!r} but no "
+                f"distrib.dispatch event carries that span_id")
+    if len(trace_ids) > 1:
+        violations.append(f"multiple trace ids in one fleet trace: "
+                          f"{sorted(trace_ids)}")
+    return {
+        "processes": {str(pid): {"role": roles.get(pid), **stats}
+                      for pid, stats in sorted(per.items())},
+        "dispatch_span_ids": len(dispatch_ids),
+        "trace_ids": sorted(trace_ids),
+        "violations": violations,
+    }
+
+
+def cmd_fleet(args) -> int:
+    try:
+        doc, errors = load_trace(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"[obs] cannot read trace {args.trace}: {e}", file=sys.stderr)
+        return 2
+    if errors:
+        for err in errors:
+            print(f"[obs] {args.trace}: {err}", file=sys.stderr)
+        return 1
+    b = fleet_breakdown(doc)
+    if args.as_json:
+        print(json.dumps(b, indent=2))
+    else:
+        print(f"fleet trace: {args.trace}")
+        print("-- processes " + "-" * 31)
+        for pid, p in b["processes"].items():
+            print(f"  pid {pid:<8s} {p['role'] or '?':<14s} "
+                  f"chunks={p['chunks']:<3d} "
+                  f"dispatches={p['dispatches']:<3d} "
+                  f"chunk={p['chunk_wall_us'] / 1e3:>9.2f} ms  "
+                  f"kernel={p['kernel_wall_us'] / 1e3:>9.2f} ms")
+        if b["trace_ids"]:
+            print(f"  trace id: {', '.join(b['trace_ids'])} "
+                  f"({b['dispatch_span_ids']} dispatch span ids)")
+        for v in b["violations"]:
+            print(f"[obs] VIOLATION: {v}", file=sys.stderr)
+        if not b["violations"]:
+            print("[obs] OK: trace-context parenting holds")
+    return 1 if b["violations"] else 0
+
+
 def _sub_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m racon_tpu.obs",
@@ -360,13 +552,32 @@ def _sub_parser() -> argparse.ArgumentParser:
                         "seconds (default 0.05)")
     b.add_argument("--json", action="store_true", dest="as_json")
     b.set_defaults(fn=cmd_bench)
+
+    mg = sub.add_parser("merge",
+                        help="fold per-process traces (coordinator + "
+                             "workers) into one multi-track timeline, "
+                             "re-based onto the earliest monotonic epoch")
+    mg.add_argument("traces", nargs="+",
+                    help="trace files to merge (any order)")
+    mg.add_argument("--out", required=True,
+                    help="path for the merged Chrome-trace JSON")
+    mg.set_defaults(fn=cmd_merge)
+
+    fl = sub.add_parser("fleet",
+                        help="per-process breakdown of a merged fleet "
+                             "trace + trace-context parenting check; "
+                             "exit 1 on a dangling parent or mixed "
+                             "trace ids")
+    fl.add_argument("trace")
+    fl.add_argument("--json", action="store_true", dest="as_json")
+    fl.set_defaults(fn=cmd_fleet)
     return p
 
 
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
-    if argv and argv[0] in ("model", "validate", "bench"):
+    if argv and argv[0] in ("model", "validate", "bench", "merge", "fleet"):
         try:
             args = _sub_parser().parse_args(argv)
         except SystemExit as e:

@@ -1,0 +1,77 @@
+"""Trace-context propagation for the multi-process surfaces.
+
+A *trace context* is two hex tokens — a fleet-wide ``trace_id`` minted
+once per coordinator/daemon run, and a ``parent`` span id minted per
+dispatch — that ride the serve/distrib newline-JSON wire (the
+``trace`` payload field of ``distrib.fetch`` / ``serve.submit``) so a
+worker's spans can be causally parented under the coordinator's
+dispatch event in the merged timeline.
+
+The current context is process-global and deliberately lives *outside*
+``obs`` arming state: ``obs.reset()`` (called by every polisher
+constructor via ``reset_run_state``) must not clear it, because a
+distrib worker activates the context *before* building the per-chunk
+polisher.  ``obs.configure`` reads ``current()`` and stamps the ids
+onto the tracer, which embeds them in every exported event's args and
+in the trace file's provenance block.
+
+Ids are random (``os.urandom``), not time-derived, so two processes
+started in the same tick cannot collide and replaying a journal cannot
+alias an old trace.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_current: Optional[dict] = None
+
+
+def mint_trace_id() -> str:
+    """64-bit random hex — one per fleet run."""
+    return os.urandom(8).hex()
+
+
+def mint_span_id() -> str:
+    """32-bit random hex — one per dispatch/submit span."""
+    return os.urandom(4).hex()
+
+
+def fresh() -> dict:
+    """A new root context (coordinator/daemon side)."""
+    return {"trace_id": mint_trace_id(), "parent": None}
+
+
+def child(ctx: Optional[dict]) -> Optional[dict]:
+    """Derive the context shipped with one dispatch: same trace id, a
+    fresh parent span id naming the dispatch event.  None stays None so
+    disarmed runs ship no context at all."""
+    if not ctx or not ctx.get("trace_id"):
+        return None
+    return {"trace_id": ctx["trace_id"], "parent": mint_span_id()}
+
+
+def activate(ctx: Optional[dict]) -> None:
+    """Install ``ctx`` as this process's current trace context (worker
+    side, from the wire; coordinator side, from ``fresh()``).  Passing a
+    malformed dict deactivates instead of half-installing."""
+    global _current
+    ok = (isinstance(ctx, dict)
+          and isinstance(ctx.get("trace_id"), str) and ctx["trace_id"])
+    with _lock:
+        _current = ({"trace_id": ctx["trace_id"],
+                     "parent": ctx.get("parent")} if ok else None)
+
+
+def clear() -> None:
+    global _current
+    with _lock:
+        _current = None
+
+
+def current() -> Optional[dict]:
+    with _lock:
+        return dict(_current) if _current else None

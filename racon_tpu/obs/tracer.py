@@ -95,9 +95,32 @@ class Tracer:
         # start near ts=0 regardless of the monotonic clock's epoch.
         self._t0 = time.monotonic_ns()
         self.pid = os.getpid()
+        #: Cross-process provenance, stamped by ``obs.configure`` from
+        #: ``obs.context`` / ``obs.set_role``.  ``role`` names this
+        #: process's track in a merged timeline ("coordinator",
+        #: "worker0", …); trace_id/parent_span tie its spans to the
+        #: fleet-wide trace context.
+        self.role: Optional[str] = None
+        self.trace_id: Optional[str] = None
+        self.parent_span: Optional[str] = None
+        # Events absorbed from other processes' shipments (already
+        # re-based onto this tracer's clock) + their metadata events.
+        self._foreign: List[dict] = []
+        self._foreign_meta: List[dict] = []
+
+    @property
+    def t0_ns(self) -> int:
+        """Monotonic epoch of this tracer's ts=0 — CLOCK_MONOTONIC is
+        system-wide on Linux, so two same-host tracers re-base each
+        other's events via the difference of their epochs."""
+        return self._t0
 
     def _ts_us(self, t_ns: int) -> int:
-        return (t_ns - self._t0) // 1000
+        # Clamp at the epoch: a span on a concurrent thread (e.g. an rpc
+        # handler) may have *started* before this tracer was re-armed for
+        # the current trace file, so its start predates t0.  Pinning it
+        # to ts=0 keeps every emitted event schema-valid (ts >= 0).
+        return max(0, (t_ns - self._t0) // 1000)
 
     def _append(self, ev: dict) -> None:
         tid = threading.get_ident()
@@ -135,24 +158,117 @@ class Tracer:
         with self._lock:
             return list(self._events)
 
+    # -- cross-process shipping -------------------------------------------
+    def export(self, max_events: Optional[int] = None,
+               metrics: Optional[dict] = None) -> dict:
+        """A JSON-ready shipment of this process's span buffer: the last
+        ``max_events`` events (newest win — the tail is where the crash
+        or the result lives), thread names, and the clock epoch a peer
+        needs to re-base them.  Bounded so a shipment always fits the
+        wire's one-line message limit."""
+        with self._lock:
+            events = list(self._events)
+            names = dict(self._thread_names)
+            dropped = self.dropped
+        if max_events is not None and len(events) > max_events:
+            dropped += len(events) - max_events
+            events = events[-max_events:]
+        ship = {
+            "pid": self.pid,
+            "t0_mono_ns": self._t0,
+            "role": self.role,
+            "trace_id": self.trace_id,
+            "dropped": dropped,
+            "thread_names": {str(t): n for t, n in names.items()},
+            "events": events,
+        }
+        if metrics is not None:
+            ship["metrics"] = metrics
+        return ship
+
+    def ingest(self, ship: dict) -> int:
+        """Absorb a peer process's ``export()``: re-base its timestamps
+        onto this tracer's clock (same-host monotonic epochs) and keep
+        its pid/tid stamps so the merged file renders one track per
+        process.  Malformed shipments are dropped whole — a worker's
+        trace must never corrupt the coordinator's.  Returns the number
+        of events absorbed."""
+        if not isinstance(ship, dict):
+            return 0
+        events = ship.get("events")
+        if not isinstance(events, list):
+            return 0
+        try:
+            dt_us = (int(ship["t0_mono_ns"]) - self._t0) // 1000
+            pid = int(ship["pid"])
+        except (KeyError, TypeError, ValueError):
+            return 0
+        absorbed = []
+        for ev in events:
+            if not isinstance(ev, dict) or "ts" not in ev:
+                continue
+            ev = dict(ev)
+            try:
+                ev["ts"] = max(0, int(ev["ts"]) + dt_us)
+                ev["pid"] = int(ev.get("pid", pid))
+                ev["tid"] = int(ev.get("tid", 0))
+            except (TypeError, ValueError):
+                continue
+            absorbed.append(ev)
+        meta = []
+        role = ship.get("role")
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "tid": 0, "args": {"name": role or f"pid{pid}"}})
+        tnames = ship.get("thread_names")
+        if isinstance(tnames, dict):
+            for t, n in sorted(tnames.items()):
+                try:
+                    meta.append({"name": "thread_name", "ph": "M",
+                                 "pid": pid, "tid": int(t),
+                                 "args": {"name": str(n)}})
+                except (TypeError, ValueError):
+                    continue
+        try:
+            foreign_dropped = int(ship.get("dropped", 0))
+        except (TypeError, ValueError):
+            foreign_dropped = 0
+        with self._lock:
+            self._foreign.extend(absorbed)
+            self._foreign_meta.extend(meta)
+            self.dropped += foreign_dropped
+        return len(absorbed)
+
     def to_dict(self, metrics: Optional[dict] = None,
                 platform: Optional[str] = None) -> dict:
         """The full Chrome-trace JSON object.  Extra top-level keys are
         ignored by Perfetto, so the metrics snapshot and provenance ride
         along in the same file the timeline lives in."""
         with self._lock:
-            events = list(self._events)
+            events = list(self._events) + list(self._foreign)
             names = dict(self._thread_names)
+            meta = list(self._foreign_meta)
             dropped = self.dropped
+        events.append({"name": "process_name", "ph": "M", "pid": self.pid,
+                       "tid": 0,
+                       "args": {"name": self.role or "racon-tpu"}})
         for tid, tname in sorted(names.items()):
             events.append({"name": "thread_name", "ph": "M", "pid": self.pid,
                            "tid": tid, "args": {"name": tname}})
+        events.extend(meta)
         doc = {
             "traceEvents": events,
             "displayTimeUnit": "ms",
             "otherData": {"tool": "racon_tpu.obs", "clock": "monotonic",
-                          "dropped_events": dropped},
+                          "dropped_events": dropped,
+                          "pid": self.pid,
+                          "t0_monotonic_ns": self._t0},
         }
+        if self.role:
+            doc["otherData"]["role"] = self.role
+        if self.trace_id:
+            doc["otherData"]["trace_id"] = self.trace_id
+            if self.parent_span:
+                doc["otherData"]["parent_span"] = self.parent_span
         if platform:
             # lets `obs validate --profile auto` pick the right machine
             # profile without re-importing the backend

@@ -209,7 +209,13 @@ class FaultPlan:
                 if windows is None or spec.window not in windows:
                     continue
             spec.fired += 1
+            from ..obs import flight
+            flight.record("fault.fired", point=point, invocation=n,
+                          spec=spec.describe())
             if spec.kill:
+                # the flight dump is the ONLY artifact this process
+                # leaves: it must land before the uncatchable signal
+                flight.dump("fault_kill", point=point, invocation=n)
                 # the deterministic preemption: no cleanup, no flush —
                 # the process is gone mid-append, exactly like a real
                 # SIGKILL/OOM/eviction

@@ -61,6 +61,15 @@ class TierDead(Exception):
     def __init__(self, cause: BaseException):
         super().__init__(str(cause))
         self.cause = cause
+        # a dead tier is a crash-adjacent event: dump the flight ring at
+        # raise time (covers TierWedged too) so the post-mortem exists
+        # even if a caller turns this into a process exit
+        from ..obs import flight
+        flight.record("lattice.tier_dead", kind="event",
+                      error=f"{type(cause).__name__}: {cause}",
+                      wedged=isinstance(self, TierWedged))
+        flight.dump("tier_dead",
+                    error=f"{type(cause).__name__}: {cause}")
 
 
 class TierWedged(TierDead):

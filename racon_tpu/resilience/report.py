@@ -139,6 +139,9 @@ class RunReport:
         # (analysis/rules/clock.py) enforces this repo-wide
         self._t0 = time.monotonic()
         self.wall_s = None
+        # flight-recorder dumps swept from the workdir after the run
+        # (obs/flight.py `scan` docs) — each entry is one post-mortem
+        self.flight: List[dict] = []
 
     def attach(self, phase_report: Optional[PhaseReport]) -> None:
         if phase_report is not None:
@@ -170,6 +173,12 @@ class RunReport:
                     **({"metrics": obs.snapshot(),
                         "served_sum": obs.served_sum_check(self.phases)}
                        if obs.enabled() else {})},
+            # post-mortem references: one compact entry per flight dump
+            # found after the run (the dump file holds the full ring)
+            "flight": [{"path": d.get("path"), "pid": d.get("pid"),
+                        "role": d.get("role"), "reason": d.get("reason"),
+                        "events": len(d.get("events") or [])}
+                       for d in self.flight],
             "wall_s": round(self.wall_s if self.wall_s is not None
                             else time.monotonic() - self._t0, 3),
         }

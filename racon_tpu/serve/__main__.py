@@ -1,11 +1,14 @@
 """`python -m racon_tpu.serve` / `python -m racon_tpu.cli serve` —
-run the resident polishing daemon."""
+run the resident polishing daemon, or (with ``--stats-watch``) poll a
+running daemon's live telemetry without starting one."""
 
 from __future__ import annotations
 
 import argparse
+import json
 import signal
 import sys
+import time
 
 from .server import ServeDaemon
 
@@ -56,11 +59,45 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="mismatch score to warm kernels for (default -5)")
     p.add_argument("-g", "--gap", type=int, default=-4,
                    help="gap penalty to warm kernels for (default -4)")
+    p.add_argument("--stats-watch", action="store_true",
+                   help="do not start a daemon: connect to the one whose "
+                   "serve.json lives in --state-dir and print its stats "
+                   "(one JSON line per poll), then exit")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between --stats-watch polls (default 2)")
+    p.add_argument("--count", type=int, default=1,
+                   help="number of --stats-watch polls before exiting "
+                   "(default 1; 0 = poll until the daemon goes away)")
     return p
+
+
+def stats_watch(state_dir: str, interval: float, count: int) -> int:
+    """Poll a running daemon's ``stats`` op and print one JSON line per
+    sample.  Exits 0 after ``count`` polls, 1 if the daemon cannot be
+    reached (including when it goes away mid-watch)."""
+    from .client import ServeClient, ServeError
+    polls = 0
+    while True:
+        try:
+            with ServeClient.from_state_dir(state_dir, timeout=10.0) as c:
+                resp = c.stats()
+        except (OSError, ValueError, ServeError) as e:
+            print(f"[racon_tpu::serve] stats-watch: daemon unreachable: "
+                  f"{e}", file=sys.stderr)
+            return 1
+        resp.pop("ok", None)
+        print(json.dumps(resp, sort_keys=True), flush=True)
+        polls += 1
+        if count > 0 and polls >= count:
+            return 0
+        time.sleep(max(0.1, interval))
 
 
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
+
+    if args.stats_watch:
+        return stats_watch(args.state_dir, args.interval, args.count)
 
     from ..resilience import faults
     try:
@@ -85,9 +122,14 @@ def main(argv=None) -> int:
         warm_scores=(args.match, args.mismatch, args.gap),
         host_lane=not args.no_host_lane)
 
+    from ..obs import flight
+    flight.set_role("serve")
+    flight.set_dir(args.state_dir)
+
     def _stop(signum, frame):
         print(f"[racon_tpu::serve] signal {signum}: shutting down "
               f"(queued jobs stay recoverable)", file=sys.stderr)
+        flight.dump("sigterm", signal=int(signum))
         daemon.stop(wait=False)
 
     signal.signal(signal.SIGTERM, _stop)

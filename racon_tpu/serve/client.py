@@ -70,13 +70,15 @@ class ServeClient:
     def submit(self, sequences: str, overlaps: str, target: str,
                args: Optional[dict] = None, include_unpolished: bool = False,
                backend: str = "", job_id: str = "",
-               submitter: str = "", window_budget: int = 0) -> str:
+               submitter: str = "", window_budget: int = 0,
+               trace: Optional[dict] = None) -> str:
         resp = self.rpc(op="submit", sequences=sequences, overlaps=overlaps,
                         target=target, args=args or {},
                         include_unpolished=include_unpolished,
                         backend=backend, job_id=job_id,
                         submitter=submitter or f"pid{os.getpid()}",
-                        window_budget=window_budget)
+                        window_budget=window_budget,
+                        trace=trace)
         return resp["job_id"]
 
     def status(self, job_id: str) -> dict:

@@ -111,15 +111,37 @@ def run_loadtest(port: int, paths: dict, jobs: int, clients: int,
         except (ServeError, OSError, threading.BrokenBarrierError) as e:
             errors.append(f"client {ci}: {type(e).__name__}: {e}")
 
+    stats_samples: List[dict] = []
+    stop_poll = threading.Event()
+
+    def stats_loop() -> None:
+        # live-telemetry scrape: the daemon's `stats` op once a second
+        # while the clients drive it — queue depths and the telemetry
+        # ring under load, not just the end-state
+        try:
+            with ServeClient(port, timeout=timeout) as c:
+                while not stop_poll.is_set():
+                    resp = c.stats()
+                    resp.pop("ok", None)
+                    stats_samples.append(resp)  # concurrency: append-only; read after join
+                    stop_poll.wait(1.0)
+        except (ServeError, OSError):
+            return  # polling is observation; it must never fail the run
+
     t_start = time.monotonic()
     threads = [threading.Thread(target=client_loop, args=(ci,),
                                 name=f"loadtest-c{ci}", daemon=True)
                for ci in range(clients)]
+    poller = threading.Thread(target=stats_loop, name="loadtest-stats",
+                              daemon=True)
+    poller.start()
     for t in threads:
         t.start()
     for t in threads:
         t.join()
     makespan = time.monotonic() - t_start
+    stop_poll.set()
+    poller.join(timeout=5.0)
 
     completed = [r for r in per_job if r is not None]
     if not completed:
@@ -159,6 +181,16 @@ def run_loadtest(port: int, paths: dict, jobs: int, clients: int,
         "warm_mbps": (round(warm_bp / 1e6 / warm_wall, 6)
                       if warm_wall else None),
         "warm_kernel_builds": sum(r["kernel_builds"] or 0 for r in warm),
+        # scraped daemon-side telemetry: sample count, the peak queued
+        # depth seen across polls, and the final sample (with the
+        # daemon's own telemetry ring) — bounded, not the full series
+        "daemon_stats": {
+            "samples": len(stats_samples),
+            "max_queued": max(
+                (sum((s.get("queued") or {}).values())
+                 for s in stats_samples), default=0),
+            "last": stats_samples[-1] if stats_samples else None,
+        },
         "per_job": completed,
     }
     return summary

@@ -20,14 +20,25 @@ file object (``socket.makefile("rwb")``).
 from __future__ import annotations
 
 import json
+import time
 from typing import Optional
+
+from .. import obs
 
 #: Protocol guard: one message line must fit comfortably in memory.
 MAX_LINE = 1 << 20
 
 
 def read_message(f) -> Optional[dict]:
-    """Read one newline-framed JSON object.  None = clean EOF."""
+    """Read one newline-framed JSON object.  None = clean EOF.
+
+    When tracing is armed the receive is stamped as an ``rpc.recv``
+    span with the payload byte size, so queueing vs transport vs
+    compute separate cleanly in the merged fleet timeline.  The stamp
+    covers the blocking read — on a server connection that includes the
+    idle wait for the next request, which is exactly the queueing-gap
+    signal the fleet breakdown keys off."""
+    t0 = time.monotonic_ns()
     line = f.readline(MAX_LINE)
     if not line:
         return None
@@ -36,13 +47,21 @@ def read_message(f) -> Optional[dict]:
     msg = json.loads(line)
     if not isinstance(msg, dict):
         raise ValueError("message must be a JSON object")
+    obs.add_complete("rpc.recv", t0, time.monotonic_ns(), cat="rpc",
+                     bytes=len(line), op=msg.get("op"))
     return msg
 
 
 def write_message(f, msg: dict) -> None:
-    """Frame and flush one object (the flush is the send)."""
-    f.write(json.dumps(msg).encode() + b"\n")
+    """Frame and flush one object (the flush is the send).  Armed, the
+    serialize+flush is stamped as an ``rpc.send`` span with the payload
+    byte size (see ``read_message``)."""
+    t0 = time.monotonic_ns()
+    data = json.dumps(msg).encode() + b"\n"
+    f.write(data)
     f.flush()
+    obs.add_complete("rpc.send", t0, time.monotonic_ns(), cat="rpc",
+                     bytes=len(data), op=msg.get("op"))
 
 
 # ---------------------------------------------------------------------------
@@ -69,7 +88,8 @@ PROTOCOL = {
                  "resp": ("pid", "backend", "port")},
         "submit": {"req": ("sequences", "overlaps", "target"),
                    "opt": ("args", "include_unpolished", "backend",
-                           "job_id", "submitter", "window_budget"),
+                           "job_id", "submitter", "window_budget",
+                           "trace"),
                    "resp": ("job_id", "lane", "demotions")},
         "status": {"req": ("job_id",), "opt": (),
                    "resp": ("job_id", "state", "lane", "submitter",
@@ -85,7 +105,7 @@ PROTOCOL = {
                             "running_s")},
         "stats": {"req": (), "opt": (),
                   "resp": ("jobs", "queued", "queue_depth", "max_jobs",
-                           "window_budget", "session")},
+                           "window_budget", "session", "telemetry")},
         "shutdown": {"req": (), "opt": (), "resp": ("bye",)},
     },
     "distrib": {
@@ -96,9 +116,12 @@ PROTOCOL = {
         "heartbeat": {"req": ("worker", "chunk", "attempt"), "opt": (),
                       "resp": ("cancel",)},
         "result": {"req": ("worker", "chunk", "attempt", "output"),
-                   "opt": ("stats",), "resp": ("accepted",)},
+                   "opt": ("stats", "obs"), "resp": ("accepted",)},
         "error": {"req": ("worker", "chunk", "attempt"),
                   "opt": ("error",), "resp": ()},
+        "stats": {"req": (), "opt": (),
+                  "resp": ("chunks", "leases", "workers", "served",
+                           "staleness_s", "counters", "telemetry")},
     },
 }
 
@@ -108,5 +131,5 @@ PROTOCOL = {
 PAYLOADS = {
     "distrib.fetch.chunk": ("index", "attempt", "sequences", "overlaps",
                             "target", "args", "include_unpolished",
-                            "backend", "journal", "output"),
+                            "backend", "journal", "output", "trace"),
 }

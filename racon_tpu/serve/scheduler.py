@@ -45,6 +45,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from .. import obs
 from .session import (JobCancelled, JobSpec, PolishSession, serve_max_jobs,
                       serve_queue_depth, serve_window_budget)
 
@@ -332,6 +333,9 @@ class Scheduler:
             "max_jobs": self.max_jobs,
             "window_budget": self.window_budget,
             "session": self.session.stats(),
+            # recent metrics-snapshot ring (obs.telemetry_tick entries,
+            # stamped per finished job) — what `--stats-watch` polls
+            "telemetry": obs.telemetry(last=8),
         }
 
     # -- queue mechanics (call with self._cv held) -------------------------
@@ -413,9 +417,12 @@ class Scheduler:
             job.result = result
             job.error = error
             job.t_end = time.monotonic()
+        # persist before signalling done: a waiter released by done.wait()
+        # must find result.json on disk (clients read it immediately)
+        self._persist_result(job)
+        with self._cv:
             job.done.set()
             self._cv.notify_all()
-        self._persist_result(job)
 
     # -- host lane ---------------------------------------------------------
 

@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from .. import config, obs
+from ..obs import context, flight
 from ..polisher import create_polisher
 
 #: Polish parameters a job may override, with the CLI defaults — the
@@ -72,6 +73,10 @@ class JobSpec:
     job_id: str = ""
     submitter: str = "local"
     window_budget: int = 0
+    #: Optional trace context ({"trace_id", "parent"}) from the
+    #: submitter, so the job's spans parent under the caller's timeline
+    #: when the traces are merged (obs/context.py).
+    trace: Optional[dict] = None
 
     def validate(self) -> None:
         unknown = sorted(set(self.args) - set(POLISH_ARG_DEFAULTS))
@@ -107,13 +112,14 @@ class JobSpec:
             "job_id": self.job_id,
             "submitter": self.submitter,
             "window_budget": self.window_budget,
+            "trace": dict(self.trace) if self.trace else None,
         }
 
     @classmethod
     def from_dict(cls, d: dict) -> "JobSpec":
         unknown = sorted(set(d) - {
             "sequences", "overlaps", "target", "args", "include_unpolished",
-            "backend", "job_id", "submitter", "window_budget"})
+            "backend", "job_id", "submitter", "window_budget", "trace"})
         if unknown:
             raise ValueError(f"unknown job field(s): {', '.join(unknown)}")
         for key in ("sequences", "overlaps", "target"):
@@ -133,6 +139,8 @@ class JobSpec:
             job_id=str(d.get("job_id") or ""),
             submitter=str(d.get("submitter") or "local"),
             window_budget=int(d.get("window_budget") or 0),
+            trace=(dict(d.get("trace"))
+                   if isinstance(d.get("trace"), dict) else None),
         )
 
 
@@ -226,48 +234,75 @@ class PolishSession:
         t0 = time.monotonic()
         if cancel is not None and cancel.is_set():
             raise JobCancelled(job_id)
-        polisher = create_polisher(
-            spec.sequences, spec.overlaps, spec.target, backend=backend,
-            journal_path=journal_path, resume_journal=True,
-            trace_path=trace_path, **spec.polish_args())
-        # The constructor armed this request's tracer; the instant event
-        # tags the per-request trace with its job id (every span in the
-        # file belongs to this job — the trace itself is per-request).
-        obs.event("serve.job", job=job_id, backend=backend, cold=cold,
-                  submitter=spec.submitter)
-        polisher.initialize()
-        if cancel is not None and cancel.is_set():
-            # Phase boundary: alignment is done and journaled; the
-            # consensus phase has not started.  The journal makes the
-            # cancellation cheap to undo — a re-run resumes from here.
-            raise JobCancelled(job_id)
-        out = polisher.polish(not spec.include_unpolished)
-        kernel_builds = obs.counter_total("kernel.builds.")
+        # trace-context propagation: a submitter's {trace_id, parent}
+        # pair (JobSpec.trace) is activated before create_polisher so
+        # the job's fresh tracer stamps it; a flight dump from this job
+        # lands in the job directory
+        context.activate(spec.trace)
+        flight.set_dir(jd)
+        try:
+            polisher = create_polisher(
+                spec.sequences, spec.overlaps, spec.target, backend=backend,
+                journal_path=journal_path, resume_journal=True,
+                trace_path=trace_path, **spec.polish_args())
+            # The constructor armed this request's tracer; the instant
+            # event tags the per-request trace with its job id (every
+            # span in the file belongs to this job — the trace itself is
+            # per-request).
+            obs.event("serve.job", job=job_id, backend=backend, cold=cold,
+                      submitter=spec.submitter)
+            polisher.initialize()
+            if cancel is not None and cancel.is_set():
+                # Phase boundary: alignment is done and journaled; the
+                # consensus phase has not started.  The journal makes the
+                # cancellation cheap to undo — a re-run resumes from here.
+                raise JobCancelled(job_id)
+            out = polisher.polish(not spec.include_unpolished)
+            kernel_builds = obs.counter_total("kernel.builds.")
 
-        with open(out_path, "w") as f:
-            for name, data in out:
-                f.write(f">{name}\n{data}\n")
-        report_doc = dict(polisher.report.as_dict())
-        report_doc["job_id"] = job_id
-        with open(report_path, "w") as f:
-            json.dump(report_doc, f, indent=1)
-            f.write("\n")
+            with open(out_path, "w") as f:
+                for name, data in out:
+                    f.write(f">{name}\n{data}\n")
+            report_doc = dict(polisher.report.as_dict())
+            report_doc["job_id"] = job_id
+            with open(report_path, "w") as f:
+                json.dump(report_doc, f, indent=1)
+                f.write("\n")
 
-        self.jobs_run += 1
-        return {
-            "job_id": job_id,
-            "backend": backend,
-            "cold": cold,
-            "wall_s": round(time.monotonic() - t0, 4),
-            "records": len(out),
-            "polished_bp": sum(len(data) for _, data in out),
-            "kernel_builds": kernel_builds,
-            "journal_replayed": _journal_replayed(polisher.report),
-            "output": out_path,
-            "report": report_path,
-            "trace": trace_path,
-            "summary": polisher.report.summary(),
-        }
+            self.jobs_run += 1
+            obs.telemetry_tick(jobs_run=self.jobs_run, job=job_id)
+            # bounded span shipment: rides inside the result payload so
+            # a tracing submitter can absorb this job's spans into its
+            # own merged timeline
+            ship = obs.shipment()
+            return {
+                "job_id": job_id,
+                "backend": backend,
+                "cold": cold,
+                "wall_s": round(time.monotonic() - t0, 4),
+                "records": len(out),
+                "polished_bp": sum(len(data) for _, data in out),
+                "kernel_builds": kernel_builds,
+                "journal_replayed": _journal_replayed(polisher.report),
+                "output": out_path,
+                "report": report_path,
+                "trace": trace_path,
+                "obs": ship,
+                "summary": polisher.report.summary(),
+            }
+        except JobCancelled:
+            raise
+        except Exception as e:  # noqa: BLE001 — post-mortem breadcrumb;
+            # the scheduler owns the failure handling
+            flight.dump("job_error", job=job_id,
+                        error=f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            # scoped teardown: re-write the (now complete) per-job trace
+            # and disarm, so the next job — or a bare polisher in the
+            # same process — can never append into this job's file
+            obs.release(write=True)
+            context.clear()
 
     def stats(self) -> dict:
         return {

@@ -301,12 +301,17 @@ def test_bench_distrib_entry_normalizes_as_fixed_point():
         "distrib": {"workers": 3, "chunks": 6,
                     "served": {"fleet": 6, "local": 0},
                     "redispatches": 1, "journal_replayed": 2},
+        "fleet": {"workers": {"0": {"chunks": 6}},
+                  "queueing_p95_s": 0.01, "staleness_max_s": 0.2},
         "mbp": 0.5, "input": "paf", "profile": "distrib-ont",
     }
     assert normalize_entry(dict(entry)) == entry
     plain = dict(entry, profile="ont")
     assert (bench_track.series_key(entry)
             != bench_track.series_key(plain))
+    # pre-telemetry distrib entries get the explicit "not scraped" null
+    legacy = {k: v for k, v in entry.items() if k != "fleet"}
+    assert normalize_entry(legacy)["fleet"] is None
 
 
 # ------------------------------------------------ integration: real fleets
@@ -399,3 +404,158 @@ def test_cli_distrib_subcommand(tmp_path):
     rc = subprocess.call([sys.executable, "-m", "racon_tpu.obs",
                           "--validate", trace])
     assert rc == 0
+
+
+# --------------------------------------------- fleet tracing + flight
+
+def test_fleet_trace_merges_validates_and_parents(tmp_path):
+    """Tentpole acceptance: a traced 3-worker run leaves a coordinator
+    trace (with absorbed worker shipments) plus per-chunk worker traces;
+    `obs merge` folds them into one timeline that passes `--validate`,
+    and `obs fleet` proves every `distrib.chunk` span is parented under
+    a coordinator `distrib.dispatch` span via one shared trace id —
+    while the fleet served-sum still matches the serial oracle's
+    output byte-for-byte."""
+    import glob
+    import subprocess
+    import sys
+
+    paths = _write_dataset(tmp_path)
+    oracle = _oracle_bytes(paths)
+    trace = str(tmp_path / "coord" / "trace.json")
+    coord = _coordinator(paths, tmp_path, workers=3, trace_path=trace)
+    out = str(tmp_path / "polished.fasta")
+    result = coord.run(out, timeout=180)
+    assert open(out, "rb").read() == oracle
+    assert sum(result["served"].values()) == result["chunks"]
+
+    # the coordinator absorbed worker span shipments into its own trace
+    assert result["counters"].get("obs_events_absorbed", 0) > 0
+    # live-telemetry aggregates rode back in the result
+    tel = result["telemetry"]
+    assert set(tel["workers"]) == {"0", "1", "2"}
+    for ws in tel["workers"].values():
+        assert ws["chunks"] >= 1
+        assert ws["kernel_wall_s"] >= 0.0
+    assert tel["queueing_p95_s"] is not None
+
+    worker_traces = sorted(glob.glob(
+        str(tmp_path / "coord" / "chunks" / "*" / "trace.a*.json")))
+    assert len(worker_traces) == result["chunks"]
+    merged = str(tmp_path / "merged.json")
+    rc = subprocess.call([sys.executable, "-m", "racon_tpu.obs", "merge",
+                          "--out", merged, trace] + worker_traces)
+    assert rc == 0
+    rc = subprocess.call([sys.executable, "-m", "racon_tpu.obs",
+                          "--validate", merged])
+    assert rc == 0
+    r = subprocess.run([sys.executable, "-m", "racon_tpu.obs", "fleet",
+                        merged, "--json"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    b = json.loads(r.stdout)
+    assert not b["violations"]
+    assert len(b["trace_ids"]) == 1            # one fleet run, one trace
+    roles = {p["role"] for p in b["processes"].values()}
+    assert "coordinator" in roles
+    assert any(r and r.startswith("worker") for r in roles)
+    chunks = sum(p["chunks"] for p in b["processes"].values())
+    assert chunks >= result["chunks"]          # every chunk span present
+
+
+def test_fleet_breakdown_flags_dangling_parent(tmp_path):
+    """`obs fleet` exit-1 contract: a chunk span whose parent matches no
+    dispatch span id is a causality violation, not a rendering quirk."""
+    import subprocess
+    import sys
+
+    doc = {"traceEvents": [
+        {"name": "distrib.dispatch", "ph": "i", "s": "t", "ts": 0,
+         "pid": 1, "tid": 1,
+         "args": {"span_id": "aabbccdd", "trace_id": "f" * 16}},
+        {"name": "distrib.chunk", "ph": "X", "ts": 5, "dur": 10,
+         "pid": 2, "tid": 1,
+         "args": {"parent": "deadbeef", "trace_id": "f" * 16}},
+    ]}
+    path = str(tmp_path / "bad.json")
+    json.dump(doc, open(path, "w"))
+    r = subprocess.run([sys.executable, "-m", "racon_tpu.obs", "fleet",
+                        path], capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "deadbeef" in r.stderr
+
+
+def test_sigkilled_worker_leaves_flight_dump(tmp_path, monkeypatch):
+    """Tentpole acceptance: worker 0 SIGKILLed mid-chunk (worker.result
+    kill fault) leaves a parseable flight-recorder dump in its chunk
+    directory — written *before* the uncatchable signal — and the
+    coordinator's RunReport references it."""
+    import glob
+
+    paths = _write_dataset(tmp_path, n_targets=6)
+    oracle = _oracle_bytes(paths)
+    monkeypatch.setenv("RACON_TPU_FAULT", "worker.result:kill=1:count=1")
+    monkeypatch.setenv("RACON_TPU_DISTRIB_FAULT_WORKER", "0")
+    coord = _coordinator(paths, tmp_path, workers=3,
+                         report_path=str(tmp_path / "report.json"))
+    out = str(tmp_path / "polished.fasta")
+    result = coord.run(out, timeout=180)
+    assert open(out, "rb").read() == oracle
+    assert result["counters"]["workers_dead"] == 1
+
+    dumps = glob.glob(str(tmp_path / "coord" / "**" / "flight.*.json"),
+                      recursive=True)
+    kill_docs = []
+    for p in dumps:
+        doc = json.load(open(p))            # must parse — tmp+replace
+        assert doc["clock"] == "monotonic"
+        assert isinstance(doc["events"], list)
+        if doc["reason"] == "fault_kill":
+            kill_docs.append(doc)
+    assert kill_docs, f"no fault_kill dump among {dumps}"
+    assert kill_docs[0]["role"] == "worker0"
+    # the ring caught the chunk in flight
+    names = [e["name"] for e in kill_docs[0]["events"]]
+    assert any(n.startswith("distrib.") or n == "fault.fired"
+               for n in names)
+
+    # the coordinator swept the dumps into the run report
+    assert result["flight"], "coordinator run result references no dumps"
+    rep = json.load(open(tmp_path / "report.json"))
+    reasons = {d["reason"] for d in rep["flight"]}
+    assert "fault_kill" in reasons
+    assert all(d["path"] for d in rep["flight"])
+
+
+def test_fleet_stats_scrapes_live_coordinator(tmp_path):
+    """The deepened `stats` wire verb: while a fleet run is in flight, a
+    one-shot `fleet_stats` scrape answers with chunk/lease/worker counts
+    and the coordinator's telemetry ring."""
+    import threading as _threading
+
+    paths = _write_dataset(tmp_path, n_targets=6)
+    coord = _coordinator(paths, tmp_path, workers=2)
+    out = str(tmp_path / "polished.fasta")
+    scraped = []
+
+    def probe():
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            port = getattr(coord, "port", None)
+            if port:
+                try:
+                    scraped.append(dcommon.fleet_stats(port, timeout=5.0))
+                    return
+                except (OSError, dcommon.WireError):
+                    pass
+            time.sleep(0.05)
+
+    t = _threading.Thread(target=probe, name="loadtest-stats", daemon=True)
+    t.start()
+    coord.run(out, timeout=180)
+    t.join(timeout=10)
+    assert scraped, "stats probe never reached the coordinator"
+    s = scraped[0]
+    assert s["ok"] is True
+    assert set(s["chunks"]) == {"pending", "running", "done"}
+    assert "workers" in s and "staleness_s" in s
+    assert isinstance(s["telemetry"], list)

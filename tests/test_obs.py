@@ -394,3 +394,187 @@ def test_traced_polish_span_quantiles_and_cost_counters(tmp_path,
     assert set(v["phases"]) == {"poa", "align"}
     assert v["phases"]["poa"]["predicted_s"] > 0.0
     assert any(b["kind"] == "poa" for b in v["buckets"])
+
+
+# --------------------------------------- fleet tracing: context + shipping
+
+def test_trace_context_mint_child_activate():
+    from racon_tpu.obs import context
+
+    ctx = context.fresh()
+    assert len(ctx["trace_id"]) == 16 and ctx["parent"] is None
+    kid = context.child(ctx)
+    assert kid["trace_id"] == ctx["trace_id"]
+    assert len(kid["parent"]) == 8
+    assert context.child(kid)["parent"] != kid["parent"]   # fresh per call
+    assert context.child(None) is None
+
+    context.activate(kid)
+    assert context.current() == kid
+    context.current()["parent"] = "mutated"        # returns a copy
+    assert context.current() == kid
+    context.activate({"trace_id": ""})             # invalid -> deactivated
+    assert context.current() is None
+    context.clear()
+
+
+def test_configure_idempotent_and_scoped(tmp_path):
+    """Satellite regression: re-configuring with the SAME trace path must
+    keep the armed tracer (and its spans); a DIFFERENT path starts a
+    fresh scope; release() disarms so spans cannot leak across scopes."""
+    obs.reset()
+    p1 = str(tmp_path / "a.json")
+    obs.configure(trace_path=p1)
+    with obs.span("first"):
+        pass
+    obs.configure(trace_path=p1)               # idempotent: same scope
+    with obs.span("second"):
+        pass
+    names = {e["name"] for e in obs.tracer().events()}
+    assert {"first", "second"} <= names
+
+    p2 = str(tmp_path / "b.json")
+    obs.configure(trace_path=p2)               # new scope: fresh tracer
+    names2 = {e["name"] for e in obs.tracer().events()}
+    assert "first" not in names2
+
+    path = obs.release(write=True)
+    assert path == p2
+    assert not obs.enabled()                   # released scope is disarmed
+    doc = json.load(open(p2))
+    assert "first" not in {e.get("name") for e in doc["traceEvents"]}
+    obs.reset()
+
+
+def test_export_ingest_rebase_and_tracks(tmp_path):
+    """A worker-side export absorbed by a coordinator-side tracer keeps
+    its pid track, gets its timestamps re-based onto the absorber's
+    epoch, and the merged document validates."""
+    coord = Tracer()
+    worker = Tracer()
+    worker.pid = coord.pid + 1           # simulate a second process
+    worker.role = "worker9"
+    worker._t0 = coord.t0_ns + 2_000_000     # worker clock starts 2ms later
+    worker.add_complete("distrib.chunk", worker.t0_ns,
+                        worker.t0_ns + 1_000_000, chunk=0)
+    ship = worker.export(max_events=10, metrics={"counters": {"c": 1}})
+    assert ship["role"] == "worker9" and ship["metrics"]["counters"] == {"c": 1}
+
+    assert coord.ingest(ship) == 1
+    assert coord.ingest("garbage") == 0
+    assert coord.ingest({"events": "nope"}) == 0
+    doc = coord.to_dict()
+    chunk = [e for e in doc["traceEvents"]
+             if e.get("name") == "distrib.chunk"][0]
+    assert chunk["pid"] == worker.pid
+    assert chunk["ts"] == 2000               # re-based: 2ms offset in µs
+    pnames = {(e["pid"], e["args"]["name"]) for e in doc["traceEvents"]
+              if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert (worker.pid, "worker9") in pnames
+
+    path = tmp_path / "merged_inline.json"
+    path.write_text(json.dumps(doc))
+    assert obs_cli.main(["--validate", str(path)]) == 0
+
+
+def test_export_truncation_counts_dropped():
+    t = Tracer()
+    for i in range(5):
+        t.add_complete(f"s{i}", 0, 1000)
+    ship = t.export(max_events=2)
+    assert len(ship["events"]) == 2
+    assert ship["dropped"] == 3
+    assert ship["events"][-1]["name"] == "s4"    # newest win
+
+
+def test_cli_merge_rebases_and_fleet_checks(tmp_path):
+    a = Tracer()
+    a.role = "coordinator"
+    a.add_instant("distrib.dispatch", span_id="cafe0001",
+                  trace_id="ab" * 8)
+    b = Tracer()
+    b.pid = a.pid + 1
+    b.role = "worker0"
+    b._t0 = a.t0_ns + 5_000_000
+    b.add_complete("distrib.chunk", b.t0_ns, b.t0_ns + 1000,
+                   parent="cafe0001", trace_id="ab" * 8)
+    pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    a.write(pa)
+    b.write(pb)
+    merged = str(tmp_path / "m.json")
+    assert obs_cli.main(["merge", "--out", merged, pb, pa]) == 0
+    assert obs_cli.main(["--validate", merged]) == 0
+    doc = json.load(open(merged))
+    assert len(doc["racon_tpu"]["processes"]) == 2
+    chunk = [e for e in doc["traceEvents"]
+             if e.get("name") == "distrib.chunk"][0]
+    assert chunk["ts"] == 5000           # worker epoch 5ms after base
+    assert obs_cli.main(["fleet", merged]) == 0
+
+    # drop the dispatch: the chunk's parent dangles -> exit 1
+    doc["traceEvents"] = [e for e in doc["traceEvents"]
+                          if e.get("name") != "distrib.dispatch"]
+    bad = str(tmp_path / "bad.json")
+    json.dump(doc, open(bad, "w"))
+    assert obs_cli.main(["fleet", bad]) == 1
+    # unreadable stays exit 2
+    assert obs_cli.main(["fleet", str(tmp_path / "missing.json")]) == 2
+    assert obs_cli.main(["merge", "--out", merged,
+                         str(tmp_path / "missing.json")]) == 2
+
+
+# ------------------------------------------------- flight recorder + rings
+
+def test_flight_recorder_ring_dump_and_scan(tmp_path, monkeypatch):
+    from racon_tpu.obs.flight import FlightRecorder, scan
+
+    fr = FlightRecorder(max_events=16)
+    fr.set_role("testproc")
+    for i in range(40):
+        fr.record(f"ev{i}", step=i)
+    assert fr.dump("nowhere") is None            # no dir set -> no dump
+
+    sub = tmp_path / "chunks" / "chunk000"
+    fr.set_dir(str(sub))
+    path = fr.dump("unit_test", detail_key="v")
+    doc = json.load(open(path))
+    assert doc["reason"] == "unit_test" and doc["role"] == "testproc"
+    assert len(doc["events"]) == 16              # ring capacity held
+    assert doc["events"][-1]["name"] == "ev39"   # newest kept
+    assert doc["detail"] == {"detail_key": "v"}
+
+    # recursive scan finds nested dumps and skips torn files
+    (tmp_path / "flight.999.json").write_text("{torn")
+    docs = scan(str(tmp_path))
+    assert len(docs) == 1 and docs[0]["path"] == path
+
+    monkeypatch.setenv("RACON_TPU_FLIGHT", "0")
+    fr.record("ignored")
+    assert fr.dump("disabled") is None           # knob gates dumping too
+
+
+def test_obs_event_feeds_flight_even_disarmed(monkeypatch):
+    from racon_tpu.obs import flight
+
+    monkeypatch.delenv("RACON_TPU_FLIGHT", raising=False)
+    obs.reset()
+    assert not obs.enabled()
+    obs.event("breadcrumb.disarmed", k=1)
+    names = [e["name"] for e in flight.recorder()._ring]
+    assert "breadcrumb.disarmed" in names
+
+
+def test_telemetry_ring_bounded(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_TELEMETRY_RING", "4")
+    obs.reset()
+    import racon_tpu.obs as o
+    o._telemetry = None                  # force re-size from the knob
+    for i in range(10):
+        entry = obs.telemetry_tick(queue_depth=i)
+    assert entry["queue_depth"] == 9
+    assert "t_mono_ns" in entry
+    ring = obs.telemetry()
+    assert len(ring) == 4                # bounded by the knob
+    assert ring[-1]["queue_depth"] == 9
+    assert obs.telemetry(last=2) == ring[-2:]
+    o._telemetry = None

@@ -580,12 +580,16 @@ def test_bench_serve_entry_normalizes_as_fixed_point():
         "cells_banded": None, "band_hit_rate": None,
         "serve": {"jobs": 4, "clients": 2,
                   "latency_s": {"p50": 1, "p95": 2, "p99": 3}},
+        "fleet": {"samples": 3, "max_queued": 2, "last": None},
         "mbp": 0.5, "input": "paf", "profile": "serve-ont",
     }
     assert normalize_entry(dict(entry)) == entry
     plain = dict(entry, profile="ont")
     assert (bench_track.series_key(entry)
             != bench_track.series_key(plain))
+    # pre-telemetry serve entries get the explicit "not scraped" null
+    legacy = {k: v for k, v in entry.items() if k != "fleet"}
+    assert normalize_entry(legacy)["fleet"] is None
 
 
 def test_cli_serve_subcommand_dispatches():
