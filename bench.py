@@ -385,6 +385,10 @@ def normalize_entry(e: dict) -> dict:
         # (per-worker walls, queueing p95, heartbeat staleness):
         # explicit null — "not scraped", same as a run with obs off
         e = dict(e, fleet=None)
+    if ("serve" in e or "distrib" in e) and "pool" not in e:
+        # entries written before the elastic pool existed: explicit null
+        # ("no pool-size timeline"), same as a run with the fleet off
+        e = dict(e, pool=None)
     return e
 
 
@@ -708,6 +712,8 @@ def serve_profile(jobs: int = 4, clients: int = 2) -> int:
         "serve": serve_stats,
         # scraped daemon telemetry (stats-op samples during the run)
         "fleet": summary.get("daemon_stats"),
+        # elastic pool-size timeline (None: daemon ran without a plane)
+        "pool": summary.get("pool"),
         **({"device_status": "unreachable"} if degraded else {}),
     }
     assert normalize_entry(dict(entry)) == entry, \
@@ -717,6 +723,7 @@ def serve_profile(jobs: int = 4, clients: int = 2) -> int:
         "value": round(value, 4), "vs_baseline": None,
         "kernel": config.get_str("RACON_TPU_POA_KERNEL") or "ls",
         "serve": serve_stats, "fleet": summary.get("daemon_stats"),
+        "pool": summary.get("pool"),
         "cost_model": None, "pack_split": None,
         "serial_steps": None,
         **({"device_status": "unreachable"} if degraded else {}),
@@ -793,6 +800,9 @@ def distrib_profile(workers: int = 3) -> int:
         # fleet telemetry from the coordinator: per-worker chunk/kernel
         # walls, dispatch-queue wait p95, heartbeat staleness max
         "fleet": result.get("telemetry"),
+        # elastic pool bounds + size timeline (fixed-size here: the
+        # distrib bench pins min == max == workers)
+        "pool": result.get("pool"),
     }
     assert normalize_entry(dict(entry)) == entry, \
         "distrib bench entry must be a normalize_entry fixed point"
@@ -800,7 +810,7 @@ def distrib_profile(workers: int = 3) -> int:
         "mbp": MBP, "input": INPUT, "profile": f"distrib-{PROFILE}",
         "value": round(value, 4), "vs_baseline": None,
         "kernel": "host", "distrib": distrib_stats,
-        "fleet": result.get("telemetry"),
+        "fleet": result.get("telemetry"), "pool": result.get("pool"),
         "cost_model": None, "pack_split": None, "serial_steps": None,
     })
     print(json.dumps(entry))

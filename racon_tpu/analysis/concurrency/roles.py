@@ -29,6 +29,10 @@ THREAD_ROLE_PATTERNS = {
     "distrib-accept": "coordinator accept loop (distrib/coordinator.py)",
     "distrib-conn": "coordinator per-worker connection handler",
     "distrib-heartbeat": "worker lease-renewal loop (distrib/worker.py)",
+    "fleet-accept": "fleet plane accept loop (fleet/plane.py)",
+    "fleet-conn": "fleet plane per-worker connection handler",
+    "fleet-monitor": "fleet plane autoscaler/lease monitor "
+                     "(fleet/plane.py)",
     "poa-warm": "pipelined-phases consensus warm thread (polisher.py)",
     "align-worker": "pipelined-phases alignment feeder (polisher.py)",
     "racon-tpu-watchdog-call": "device-call watchdog runner",

@@ -203,6 +203,25 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
        "distrib fault scoping: the worker index that inherits "
        "RACON_TPU_FAULT (other workers get it stripped), so chaos tests "
        "kill exactly one worker", scope="test"),
+    # -- elastic fleet knobs (racon_tpu/fleet) ----------------------------
+    _k("RACON_TPU_FLEET_MIN_WORKERS", "1", "int",
+       "elastic fleet floor: worker processes the autoscaling pool "
+       "keeps alive even when idle"),
+    _k("RACON_TPU_FLEET_MAX_WORKERS", "0", "int",
+       "elastic fleet ceiling: worker processes the pool may grow to "
+       "under load; in the serve daemon 0 disables the fleet plane "
+       "(jobs run in-process as before)"),
+    _k("RACON_TPU_FLEET_SCALE_P95_MS", "250", "float",
+       "autoscaler trigger: grow the pool when the recent chunk "
+       "queueing p95 exceeds this many milliseconds with a backlog "
+       "pending"),
+    _k("RACON_TPU_FLEET_STEAL", "1", "bool",
+       "fleet work stealing: an idle worker whose affinity job has no "
+       "eligible chunks takes a chunk from another job (0 pins workers "
+       "to their job until it finishes)"),
+    _k("RACON_TPU_FLEET_TENANT_QUOTA", "0", "int",
+       "per-tenant admission quota: unfinished jobs one submitter may "
+       "hold in the scheduler/fleet plane at once (0 = unlimited)"),
     # -- test / bench knobs ----------------------------------------------
     _k("RACON_TPU_HW_TESTS", None, "bool",
        "assert exact on-hardware pins against a real TPU backend",

@@ -12,6 +12,15 @@ from .. import config
 from ..serve.protocol import read_message, write_message
 
 
+#: Environment a spawned worker must NOT inherit: per-run artifact
+#: knobs would make every worker clobber the controller's
+#: trace/report/journal.  Shared by the distrib coordinator and the
+#: fleet plane's elastic pool.
+SCOPED_KNOBS = ("RACON_TPU_TRACE", "RACON_TPU_TRACE_DEVICE",
+                "RACON_TPU_METRICS", "RACON_TPU_REPORT",
+                "RACON_TPU_JOURNAL")
+
+
 class WireError(ConnectionError):
     """The peer closed the connection or answered ``ok: false``."""
 
@@ -47,13 +56,24 @@ def distrib_lease_ttl() -> float:
     return config.get_float("RACON_TPU_DISTRIB_LEASE_TTL")
 
 
+#: Floor on the heartbeat interval: a lease TTL small enough to push
+#: TTL/3 below this would turn the worker's renewal loop into a busy
+#: spin (and flood the coordinator with heartbeat RPCs).  A tiny TTL
+#: still expires leases fast; it just cannot melt the renewal thread.
+HEARTBEAT_FLOOR = 0.05
+
+
 def distrib_heartbeat(ttl: Optional[float] = None) -> float:
     """Heartbeat interval; defaults to a third of the lease TTL so two
-    missed beats still renew before the lease expires."""
+    missed beats still renew before the lease expires.  Clamped to
+    HEARTBEAT_FLOOR either way — an explicit RACON_TPU_DISTRIB_HEARTBEAT
+    or a tiny RACON_TPU_DISTRIB_LEASE_TTL must not busy-spin the
+    renewal loop."""
     raw = config.get_raw("RACON_TPU_DISTRIB_HEARTBEAT")
     if raw:
-        return float(raw)
-    return (distrib_lease_ttl() if ttl is None else ttl) / 3.0
+        return max(HEARTBEAT_FLOOR, float(raw))
+    return max(HEARTBEAT_FLOOR,
+               (distrib_lease_ttl() if ttl is None else ttl) / 3.0)
 
 
 def distrib_retry_base() -> float:

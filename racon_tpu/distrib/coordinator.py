@@ -37,6 +37,12 @@ Robustness model (the headline, not an afterthought):
 Ordered gather: results install per chunk index and concatenate in
 order, so the polished FASTA is byte-identical to a single-process run
 (pinned by tests/test_distrib.py and the CI chaos job's ``cmp`` gate).
+
+The lease/chunk lifecycle and the worker-process pool live in
+racon_tpu/fleet (leases.py, pool.py) — the shared core this coordinator
+and the elastic multi-job FleetPlane both run on.  The coordinator uses
+the pool at a fixed size (min == max == ``--workers``); reclaim of a
+dead worker's leases passes through the ``lease.reclaim`` fault point.
 """
 
 from __future__ import annotations
@@ -51,61 +57,24 @@ import time
 from typing import Dict, List, Optional
 
 from .. import obs
+from ..fleet.leases import (Chunk, Lease,  # noqa: F401 — re-exported;
+                            # the classes moved to the shared fleet core
+                            fire_reclaim_fault, release_worker_leases)
+from ..fleet.pool import ElasticPool
 from ..obs import context, flight
 from ..polisher import _split_fasta
 from ..resilience import faults
 from ..resilience.report import PhaseReport, RunReport
 from ..serve.protocol import read_message, write_message
 from ..serve.session import POLISH_ARG_DEFAULTS
-from .common import (distrib_fault_worker, distrib_heartbeat,
-                     distrib_lease_ttl, distrib_max_retries,
-                     distrib_retry_base, distrib_speculate, distrib_workers)
-
-#: Environment a worker must NOT inherit: per-run artifact knobs would
-#: make every worker clobber the coordinator's trace/report/journal.
-_SCOPED_KNOBS = ("RACON_TPU_TRACE", "RACON_TPU_TRACE_DEVICE",
-                 "RACON_TPU_METRICS", "RACON_TPU_REPORT",
-                 "RACON_TPU_JOURNAL")
+from .common import (SCOPED_KNOBS, distrib_fault_worker,
+                     distrib_heartbeat, distrib_lease_ttl,
+                     distrib_max_retries, distrib_retry_base,
+                     distrib_speculate, distrib_workers)
 
 #: Fleet tiers, lattice order (fleet is the device-analogue; local is
 #: the coordinator-run oracle floor).
 TIERS = ("fleet", "local")
-
-
-class Lease:
-    __slots__ = ("worker", "attempt", "deadline", "t_start", "canonical",
-                 "last_beat")
-
-    def __init__(self, worker: int, attempt: int, ttl: float,
-                 canonical: bool):
-        self.worker = worker
-        self.attempt = attempt
-        self.t_start = time.monotonic()
-        self.deadline = self.t_start + ttl
-        self.canonical = canonical   # holds the chunk's primary journal
-        self.last_beat = self.t_start   # heartbeat-staleness telemetry
-
-
-class Chunk:
-    """One contig chunk and its dispatch lifecycle."""
-
-    def __init__(self, index: int, target: str, chunk_dir: str):
-        self.index = index
-        self.target = target
-        self.dir = chunk_dir
-        self.journal = os.path.join(chunk_dir, "journal.jsonl")
-        self.state = "pending"        # pending | running | done
-        self.local = False            # demoted to coordinator execution
-        self.attempts = 0
-        self.failures = 0
-        self.next_eligible = 0.0
-        self.leases: Dict[int, Lease] = {}
-        self.tried = set()            # worker ids that have attempted
-        self.journal_held = False     # a (possibly live) writer owns it
-        self.output: Optional[str] = None
-        self.stats: dict = {}
-        self.served_by: Optional[str] = None
-        self.t_pending = time.monotonic()   # queue-wait telemetry
 
 
 class Coordinator:
@@ -149,10 +118,18 @@ class Coordinator:
         self._cv = threading.Condition()
         self._stopping = False
         self._degraded = False
-        self._procs: Dict[int, subprocess.Popen] = {}
         self._dead_workers = set()
         self._sock: Optional[socket.socket] = None
         self.port = 0
+        # fixed-size use of the shared elastic pool: min == max, filled
+        # once by start(); spawn failures shrink it, nothing regrows it
+        self.pool = ElasticPool(
+            logs_dir=os.path.join(workdir, "workers"),
+            min_workers=self.n_workers, max_workers=self.n_workers,
+            env_fn=self._worker_env,
+            on_spawn=lambda i, pid: obs.event("distrib.spawn",
+                                              worker=i, pid=pid),
+            on_spawn_failure=self._on_spawn_failure)
 
     # -- counters (mirrored into obs so the coordinator trace carries
     # -- distrib.* series even though the python dict is the source of
@@ -193,7 +170,7 @@ class Coordinator:
 
     def _worker_env(self, index: int) -> dict:
         env = dict(os.environ)
-        for k in _SCOPED_KNOBS:
+        for k in SCOPED_KNOBS:
             env.pop(k, None)
         # fault scoping: exactly one worker inherits RACON_TPU_FAULT, so
         # a chaos run kills a known worker instead of the whole fleet
@@ -201,30 +178,20 @@ class Coordinator:
             env.pop("RACON_TPU_FAULT", None)
         return env
 
+    def _on_spawn_failure(self, index: int, exc: BaseException) -> None:
+        # a spawn failure (injected or real) shrinks the fleet; it must
+        # not kill the run, which can still finish on fewer workers or
+        # degrade to local.  The pool counts spawn_failures.
+        self.phase.record_failure("fleet", exc)  # concurrency: invoked from pool.start() before any worker thread exists
+        obs.event("distrib.spawn_failed", worker=index,
+                  error=f"{type(exc).__name__}: {exc}")
+
     def _spawn_fleet(self) -> None:
-        logs_dir = os.path.join(self.workdir, "workers")
-        os.makedirs(logs_dir, exist_ok=True)
-        for i in range(self.n_workers):
-            try:
-                faults.check("worker.spawn")
-                log = open(os.path.join(logs_dir, f"worker{i}.log"), "w")
-                proc = subprocess.Popen(
-                    [sys.executable, "-m", "racon_tpu.distrib.worker",
-                     "--port", str(self.port), "--worker", str(i)],
-                    env=self._worker_env(i), stdout=log, stderr=log)
-                log.close()
-            except Exception as e:  # noqa: BLE001 — a spawn failure
-                # (injected or real) shrinks the fleet; it must not kill
-                # the run, which can still finish on fewer workers or
-                # degrade to local
-                self._count("spawn_failures")
-                self.phase.record_failure("fleet", e)
-                obs.event("distrib.spawn_failed", worker=i,
-                          error=f"{type(e).__name__}: {e}")
-                continue
-            self._procs[i] = proc
-            self._count("workers_spawned")
-            obs.event("distrib.spawn", worker=i, pid=proc.pid)
+        with self._cv:
+            self.pool.port = self.port
+            spawned = self.pool.start()
+        if spawned:
+            self._count("workers_spawned", spawned)
 
     # -- connection handling ------------------------------------------------
 
@@ -521,20 +488,22 @@ class Coordinator:
             self._dead_workers.add(worker)
             self._count("workers_dead")
             obs.event("distrib.worker_dead", worker=worker, cause=why)
+            # the reclaim transition is a named fault point: kill=1
+            # crashes the coordinator mid-reclaim, a raise is absorbed
+            # and counted — the reclaim itself always proceeds
+            if fire_reclaim_fault():
+                self._count("reclaim_faults")
             for c in self.chunks:
-                held = [a for a, ls in c.leases.items()
-                        if ls.worker == worker]
-                for a in held:
-                    lease = c.leases.pop(a)
-                    if lease.canonical:
-                        # the writer is dead: release the canonical
-                        # journal so the re-dispatch resumes it
-                        c.journal_held = False
-                    self._count("lease_expired")
-                if held and c.state != "done":
-                    self._fail_chunk(
-                        c, RuntimeError(f"worker {worker} died ({why}) "
-                                        f"holding chunk {c.index}"))
+                # a known-dead writer releases the canonical journal so
+                # the re-dispatch resumes it
+                popped = release_worker_leases(c, worker)
+                if popped:
+                    self._count("lease_expired", len(popped))
+                    if c.state != "done":
+                        self._fail_chunk(
+                            c, RuntimeError(f"worker {worker} died "
+                                            f"({why}) holding chunk "
+                                            f"{c.index}"))
 
     def _expire_leases(self) -> None:
         now = time.monotonic()
@@ -558,8 +527,8 @@ class Coordinator:
     # -- fleet -> local degradation -----------------------------------------
 
     def _live_workers(self) -> int:
-        return sum(1 for i, p in self._procs.items()
-                   if p.poll() is None and i not in self._dead_workers)
+        return sum(1 for i in self.pool.alive_indices()
+                   if i not in self._dead_workers)
 
     def _degrade(self, cause: str) -> None:
         """Record the fleet→local lattice step (once per run)."""
@@ -598,7 +567,7 @@ class Coordinator:
             cmd.append("-u")
         cmd += [self.sequences, self.overlaps, c.target]
         env = dict(os.environ)
-        for k in _SCOPED_KNOBS:
+        for k in SCOPED_KNOBS:
             env.pop(k, None)
         t0 = time.monotonic()
         with open(part, "w") as out_f, \
@@ -663,7 +632,11 @@ class Coordinator:
             self.report.flight = flight.scan(self.workdir)
             if self.report.flight:
                 self._count("flight_dumps", len(self.report.flight))
-            self.phase.extra.update(self.counters)
+            # pool counters (spawn_failures, scale_* fault absorbs)
+            # merge under the coordinator's own, which win on overlap
+            counters = dict(self.pool.counters)
+            counters.update(self.counters)
+            self.phase.extra.update(counters)
             if self.report_path:
                 self.report.write(self.report_path)
             self.report.write_env()
@@ -674,11 +647,15 @@ class Coordinator:
                 "workers": self.n_workers,
                 "served": dict(self.phase.served),
                 "degradations": list(self.phase.degradations),
-                "counters": dict(self.counters),
+                "counters": counters,
                 "journal_replayed": replayed,
                 "report": self.report_path,
                 "trace": self.trace_path,
                 "telemetry": self.fleet_telemetry(),
+                "pool": {"min": self.pool.min_workers,
+                         "max": self.pool.max_workers,
+                         "timeline": [list(s) for s in
+                                      self.pool.size_timeline]},
                 "flight": [d.get("path") for d in self.report.flight],
                 "summary": self.report.summary(),
             }
@@ -701,9 +678,10 @@ class Coordinator:
                     f"chunk(s) unfinished")
             # reap dead worker processes (second death signal, for a
             # worker that died before ever connecting)
-            for i, p in list(self._procs.items()):
-                if p.poll() is not None and i not in self._dead_workers:
-                    self._worker_dead(i, f"exited {p.returncode}")
+            with self._cv:
+                reaped = self.pool.reap()
+            for i, rc, _was_draining in reaped:
+                self._worker_dead(i, f"exited {rc}")
             self._expire_leases()
             now = time.monotonic()
             if now - self._last_tick >= 1.0:
@@ -750,13 +728,7 @@ class Coordinator:
     def _shutdown_fleet(self) -> None:
         with self._cv:
             self._stopping = True
-        t0 = time.monotonic()
-        for p in self._procs.values():
-            while p.poll() is None and time.monotonic() - t0 < 5.0:
-                time.sleep(0.05)
-            if p.poll() is None:
-                p.kill()
-                p.wait()
+        self.pool.shutdown(timeout=5.0)
         if self._sock is not None:
             try:
                 self._sock.close()

@@ -1,0 +1,52 @@
+"""Elastic multi-tenant polishing fleet: the shared control-plane core.
+
+`racon-tpu serve` (whole-job scheduler, serve/scheduler.py) and
+`racon-tpu distrib` (single-job chunk coordinator,
+distrib/coordinator.py) grew the same machinery twice — queues with
+round-robin fairness, worker processes, leases.  This package is the
+refactor that gives both one core, plus the piece neither had: an
+autoscaling chunk-level control plane.
+
+* ``queues``  — per-tenant FIFOs with priority lanes served in
+  round-robin rotation (the scheduler's fairness, generalized).
+* ``leases``  — the TTL lease + chunk lifecycle shared by the distrib
+  coordinator and the fleet plane (moved from coordinator.py).
+* ``pool``    — ``ElasticPool``: worker-process lifecycle (spawn, reap,
+  drain, kill) with deterministic ``pool.scale_up`` /
+  ``pool.scale_down`` fault points.  The coordinator uses it at a fixed
+  size (min == max); the plane scales it from live signals.
+* ``plane``   — ``FleetPlane``: many jobs, one chunk queue, one elastic
+  worker pool.  Work-stealing between jobs, per-tenant quotas and
+  priorities, speculation and lease reclaim inherited from the distrib
+  layer, graceful scale-down that drains leases, and a host-oracle
+  floor so output stays byte-identical under any churn.
+"""
+
+from .. import config
+from .queues import TenantQueues  # noqa: F401
+from .leases import Chunk, Lease  # noqa: F401
+from .pool import ElasticPool  # noqa: F401
+
+
+#: Fleet knob accessors (registered in racon_tpu/config.py; README has
+#: the docs rows).  Centralized here so the scheduler, the plane, and
+#: the serve CLI share defaults.
+
+def fleet_min_workers() -> int:
+    return config.get_int("RACON_TPU_FLEET_MIN_WORKERS")
+
+
+def fleet_max_workers() -> int:
+    return config.get_int("RACON_TPU_FLEET_MAX_WORKERS")
+
+
+def fleet_scale_p95_ms() -> float:
+    return config.get_float("RACON_TPU_FLEET_SCALE_P95_MS")
+
+
+def fleet_steal_enabled() -> bool:
+    return config.get_bool("RACON_TPU_FLEET_STEAL")
+
+
+def fleet_tenant_quota() -> int:
+    return config.get_int("RACON_TPU_FLEET_TENANT_QUOTA")

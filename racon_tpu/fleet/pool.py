@@ -1,0 +1,225 @@
+"""ElasticPool: worker-process lifecycle for chunk fleets.
+
+One abstraction for both controllers: the distrib coordinator runs it
+at a fixed size (min == max, filled once by ``start()``), the fleet
+plane grows and shrinks it from live signals.  The pool owns process
+mechanics only — spawn, reap, drain, kill, and the pool-size timeline
+stamped into bench entries; *when* to scale is the owner's policy.
+
+Scale transitions are named control-plane seams with deterministic
+fault points (resilience/faults.py):
+
+* ``pool.scale_up``   — checked once per growth decision, before any
+  process is spawned.  kill=1 crashes the controller mid-resize (the
+  serve recover() interplay test is built on it); an injected raise is
+  absorbed, counted in ``counters['scale_up_faults']``, and the growth
+  step is skipped — the pool stays at its current size, which is the
+  degraded-but-safe outcome.
+* ``pool.scale_down`` — checked once per drain decision, same absorb
+  semantics.  A skipped scale-down just keeps workers alive.
+* ``worker.spawn``    — checked per process launched (inherited from
+  the distrib coordinator; a spawn failure shrinks the fleet, never
+  kills the run).
+
+Scale-down is *graceful by construction*: a victim is only marked
+draining here; the owner answers its next ``fetch`` with ``drain`` —
+and a worker only fetches between chunks, so a draining worker never
+holds a lease and a canonical journal can never be orphaned by a
+resize.
+
+Threading: every mutating entry point runs under the owner's condition
+variable (the coordinator's / plane's ``_cv``), exactly like the
+process dict this replaces.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+from .. import obs
+from ..resilience import faults
+
+
+class ElasticPool:  # concurrency: every mutating entry point is called under the owner's _cv (documented contract, same as the coordinator's former _procs dict)
+    def __init__(self, logs_dir: str, min_workers: int, max_workers: int,
+                 env_fn: Optional[Callable[[int], dict]] = None,
+                 port: int = 0,
+                 on_spawn: Optional[Callable[[int, int], None]] = None,
+                 on_spawn_failure: Optional[
+                     Callable[[int, BaseException], None]] = None):
+        self.logs_dir = logs_dir
+        self.min_workers = max(0, int(min_workers))
+        self.max_workers = max(self.min_workers, int(max_workers))
+        self.port = port            # set by the owner before start()
+        self._env_fn = env_fn
+        self._on_spawn = on_spawn
+        self._on_spawn_failure = on_spawn_failure
+        self._procs: Dict[int, subprocess.Popen] = {}
+        self._draining: set = set()
+        self._reaped: set = set()
+        self._next_index = 0
+        self.counters: Dict[str, int] = {}
+        self.size_timeline: List[list] = []   # [t_rel_s, live] samples
+        self._t0 = time.monotonic()
+
+    # -- introspection ------------------------------------------------------
+
+    def live(self) -> int:
+        """Processes still running (draining ones included — they hold
+        no lease but still count against the ceiling until they exit)."""
+        return sum(1 for p in self._procs.values() if p.poll() is None)
+
+    def active(self) -> int:
+        """Live workers that are not draining — the dispatch capacity."""
+        return sum(1 for i, p in self._procs.items()
+                   if p.poll() is None and i not in self._draining)
+
+    def is_draining(self, worker: int) -> bool:
+        return worker in self._draining
+
+    def indices(self) -> List[int]:
+        return sorted(self._procs)
+
+    def alive_indices(self) -> List[int]:
+        return sorted(i for i, p in self._procs.items()
+                      if p.poll() is None)
+
+    def _count(self, name: str, n: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def _sample(self) -> None:
+        self.size_timeline.append(
+            [round(time.monotonic() - self._t0, 3), self.live()])
+
+    # -- spawning -----------------------------------------------------------
+
+    def _spawn_one(self) -> Optional[int]:
+        """Launch one worker process; None on (injected or real) spawn
+        failure — a failed spawn shrinks the fleet, it must not kill
+        the run."""
+        index = self._next_index
+        self._next_index += 1
+        try:
+            faults.check("worker.spawn")
+            os.makedirs(self.logs_dir, exist_ok=True)
+            log = open(os.path.join(self.logs_dir,
+                                    f"worker{index}.log"), "w")
+            env = self._env_fn(index) if self._env_fn else None
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "racon_tpu.distrib.worker",
+                 "--port", str(self.port), "--worker", str(index)],
+                env=env, stdout=log, stderr=log)
+            log.close()
+        except Exception as e:  # noqa: BLE001 — injected or real; the
+            # owner records it and the run continues on fewer workers
+            self._count("spawn_failures")
+            if self._on_spawn_failure:
+                self._on_spawn_failure(index, e)
+            return None
+        self._procs[index] = proc
+        self._count("workers_spawned")
+        if self._on_spawn:
+            self._on_spawn(index, proc.pid)
+        self._sample()
+        return index
+
+    def start(self) -> int:
+        """Fill the pool to its floor (no scale event — the floor is
+        the configured baseline, not a growth decision)."""
+        spawned = 0
+        for _ in range(self.min_workers):
+            if self._spawn_one() is not None:
+                spawned += 1
+        return spawned
+
+    def scale_up(self, n: int = 1, cause: str = "") -> int:
+        """Grow by up to n workers (bounded by the ceiling); returns
+        how many actually spawned.  One ``pool.scale_up`` check guards
+        the whole decision."""
+        room = self.max_workers - self.live()
+        n = min(n, room)
+        if n <= 0:
+            return 0
+        try:
+            faults.check("pool.scale_up")
+        except Exception:  # noqa: BLE001 — absorbed: a faulted resize
+            # skips the growth step; staying small is the safe outcome
+            self._count("scale_up_faults")
+            return 0
+        spawned = sum(1 for _ in range(n)
+                      if self._spawn_one() is not None)
+        if spawned:
+            self._count("scale_ups")
+            obs.count("fleet.scale_ups", spawned)
+            obs.event("fleet.scale_up", added=spawned, live=self.live(),
+                      cause=cause)
+        return spawned
+
+    # -- draining / reaping -------------------------------------------------
+
+    def scale_down(self, n: int = 1, cause: str = "") -> List[int]:
+        """Mark up to n workers draining (never below the floor);
+        returns the victim indices.  The owner answers each victim's
+        next fetch with ``drain`` — a worker only fetches between
+        chunks, so no lease (and no canonical journal) is ever cut."""
+        victims: List[int] = []
+        headroom = self.active() - self.min_workers
+        n = min(n, headroom)
+        if n <= 0:
+            return victims
+        try:
+            faults.check("pool.scale_down")
+        except Exception:  # noqa: BLE001 — absorbed: a faulted drain
+            # keeps the worker alive, which is the safe outcome
+            self._count("scale_down_faults")
+            return victims
+        # newest first: oldest workers have the hottest kernel caches
+        for index in sorted(self._procs, reverse=True):
+            if len(victims) >= n:
+                break
+            if (self._procs[index].poll() is None
+                    and index not in self._draining):
+                self._draining.add(index)
+                victims.append(index)
+        if victims:
+            self._count("scale_downs", len(victims))
+            obs.count("fleet.scale_downs", len(victims))
+            obs.event("fleet.scale_down", drained=victims,
+                      live=self.live(), cause=cause)
+            self._sample()
+        return victims
+
+    def reap(self) -> List[tuple]:
+        """Newly-exited workers as (index, returncode, was_draining) —
+        each reported exactly once.  The owner decides whether an exit
+        is a death (lease reclaim) or a completed drain."""
+        out = []
+        for index, proc in self._procs.items():
+            if proc.poll() is not None and index not in self._reaped:
+                self._reaped.add(index)
+                out.append((index, proc.returncode,
+                            index in self._draining))
+        if out:
+            self._sample()
+        return out
+
+    # -- shutdown -----------------------------------------------------------
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        """Wait for workers to drain out, then kill any leftover — the
+        zero-leaked-processes guarantee the chaos CI gates on."""
+        t0 = time.monotonic()
+        for p in self._procs.values():
+            while p.poll() is None and time.monotonic() - t0 < timeout:
+                time.sleep(0.05)
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        self._sample()
+    # shutdown() runs after the owner's serving loop has stopped; the
+    # wait/kill sweep deliberately happens outside any lock so a slow
+    # worker exit cannot stall connection teardown elsewhere.

@@ -412,6 +412,13 @@ def fleet_breakdown(doc: dict) -> dict:
     dispatch_ids = set()
     trace_ids = set()
     violations: List[str] = []
+    # elastic-fleet control-plane events (fleet/plane.py + pool.py):
+    # pool resizes, cross-job steals, admission sheds
+    elastic = {"scale_ups": 0, "scale_downs": 0, "steals": 0, "sheds": 0}
+    _ELASTIC_NAMES = {"fleet.scale_up": "scale_ups",
+                      "fleet.scale_down": "scale_downs",
+                      "fleet.steal": "steals",
+                      "serve.shed": "sheds"}
     for ev in doc.get("traceEvents", []):
         if not isinstance(ev, dict):
             continue
@@ -447,6 +454,8 @@ def fleet_breakdown(doc: dict) -> dict:
                     dispatch_ids.add(args["span_id"])
                 if args.get("trace_id"):
                     trace_ids.add(args["trace_id"])
+            elif name in _ELASTIC_NAMES:
+                elastic[_ELASTIC_NAMES[name]] += 1
     # second pass: parenting — a chunk span's parent must be a dispatch
     for ev in doc.get("traceEvents", []):
         if not (isinstance(ev, dict) and ev.get("ph") == "X"
@@ -467,6 +476,7 @@ def fleet_breakdown(doc: dict) -> dict:
                       for pid, stats in sorted(per.items())},
         "dispatch_span_ids": len(dispatch_ids),
         "trace_ids": sorted(trace_ids),
+        "elastic": elastic,
         "violations": violations,
     }
 
@@ -496,6 +506,11 @@ def cmd_fleet(args) -> int:
         if b["trace_ids"]:
             print(f"  trace id: {', '.join(b['trace_ids'])} "
                   f"({b['dispatch_span_ids']} dispatch span ids)")
+        e = b["elastic"]
+        if any(e.values()):
+            print(f"  elastic: scale_ups={e['scale_ups']} "
+                  f"scale_downs={e['scale_downs']} steals={e['steals']} "
+                  f"sheds={e['sheds']}")
         for v in b["violations"]:
             print(f"[obs] VIOLATION: {v}", file=sys.stderr)
         if not b["violations"]:

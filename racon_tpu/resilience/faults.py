@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import os
 import signal
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
@@ -94,6 +95,21 @@ KNOWN_POINTS = frozenset({
     "worker.spawn",      # coordinator, per worker process launched
     "worker.heartbeat",  # worker, before each heartbeat send
     "worker.result",     # worker, before delivering a chunk result
+    # elastic control plane seams (racon_tpu/fleet): the pool checks
+    # pool.scale_up / pool.scale_down before growing / draining the
+    # worker fleet, the plane checks pool.steal before handing a chunk
+    # of job A to a worker whose affinity is job B, and every lease
+    # reclaim (worker death or drain) checks lease.reclaim before
+    # releasing the dead holder's leases.  A raise on these points is
+    # absorbed as a modeled control-plane failure (the transition is
+    # skipped or proceeds degraded, and counted); kill=1 is the
+    # deterministic controller crash mid-transition — the recover()
+    # interplay tests are built on pool.scale_up:kill=1.
+    "pool.scale_up",     # elastic pool, before spawning a growth worker
+    "pool.scale_down",   # elastic pool, before draining a worker
+    "pool.steal",        # fleet plane, before a cross-job work steal
+    "lease.reclaim",     # lease layer, before reclaiming a dead
+                         # holder's leases
 })
 
 
@@ -190,7 +206,16 @@ def parse_spec(text: str) -> list:
 
 
 class FaultPlan:
-    """Parsed specs plus per-point invocation counters for one run."""
+    """Parsed specs plus per-point invocation counters for one run.
+
+    The plan is process-global shared state: checks come from the main
+    thread, serve/distrib/fleet connection handlers and the fleet
+    monitor, so invocation counting and spec selection happen under
+    ``_LOCK`` — a racing pair of checks must burn two distinct
+    invocation indices, or ``batch=N`` selectors stop being
+    deterministic.  The *action* (sleep/raise/SIGKILL) runs outside the
+    lock so a ``hang=S`` spec stalls only its own thread.
+    """
 
     def __init__(self, specs):
         self.specs = specs
@@ -198,34 +223,45 @@ class FaultPlan:
 
     def check(self, point: str,
               windows: Optional[Sequence[int]] = None) -> None:
-        n = self.calls.get(point, 0)
-        self.calls[point] = n + 1
-        for spec in self.specs:
-            if spec.point != point or spec.spent():
-                continue
-            if spec.batch is not None and spec.batch != n:
-                continue
-            if spec.window is not None:
-                if windows is None or spec.window not in windows:
+        with _LOCK:
+            n = self.calls.get(point, 0)
+            self.calls[point] = n + 1
+            fire = None
+            for spec in self.specs:
+                if spec.point != point or spec.spent():
                     continue
-            spec.fired += 1
-            from ..obs import flight
-            flight.record("fault.fired", point=point, invocation=n,
-                          spec=spec.describe())
-            if spec.kill:
-                # the flight dump is the ONLY artifact this process
-                # leaves: it must land before the uncatchable signal
-                flight.dump("fault_kill", point=point, invocation=n)
-                # the deterministic preemption: no cleanup, no flush —
-                # the process is gone mid-append, exactly like a real
-                # SIGKILL/OOM/eviction
-                os.kill(os.getpid(), signal.SIGKILL)
-            if spec.hang:
-                time.sleep(spec.hang)
-                return
-            raise EXCEPTIONS[spec.raise_name](
-                f"injected fault at {spec.describe()} (invocation {n})")
+                if spec.batch is not None and spec.batch != n:
+                    continue
+                if spec.window is not None:
+                    if windows is None or spec.window not in windows:
+                        continue
+                spec.fired += 1
+                fire = spec
+                break
+        if fire is None:
+            return
+        from ..obs import flight
+        flight.record("fault.fired", point=point, invocation=n,
+                      spec=fire.describe())
+        if fire.kill:
+            # the flight dump is the ONLY artifact this process
+            # leaves: it must land before the uncatchable signal
+            flight.dump("fault_kill", point=point, invocation=n)
+            # the deterministic preemption: no cleanup, no flush —
+            # the process is gone mid-append, exactly like a real
+            # SIGKILL/OOM/eviction
+            os.kill(os.getpid(), signal.SIGKILL)
+        if fire.hang:
+            time.sleep(fire.hang)
+            return
+        raise EXCEPTIONS[fire.raise_name](
+            f"injected fault at {fire.describe()} (invocation {n})")
 
+
+# Guards the plan cache and every FaultPlan counter (see
+# FaultPlan.check).  Nothing is called while holding it, so it nests
+# safely under any control-plane lock (scheduler/coordinator/plane _cv).
+_LOCK = threading.Lock()
 
 # cache keyed on the raw env string so monkeypatched environments take
 # effect immediately; counters persist while the string is unchanged
@@ -237,10 +273,11 @@ _cached_plan: Optional[FaultPlan] = None
 def _plan() -> Optional[FaultPlan]:
     global _cached_env, _cached_plan
     env = config.get_str(ENV)
-    if env != _cached_env:
-        _cached_env = env
-        _cached_plan = FaultPlan(parse_spec(env)) if env else None
-    return _cached_plan
+    with _LOCK:
+        if env != _cached_env:
+            _cached_env = env
+            _cached_plan = FaultPlan(parse_spec(env)) if env else None
+        return _cached_plan
 
 
 def active_spec() -> str:
@@ -262,8 +299,9 @@ def reset() -> None:
     """Re-arm the plan (fresh counters).  Called by the polisher
     constructors so consecutive runs fire deterministically."""
     global _cached_env, _cached_plan
-    _cached_env = None
-    _cached_plan = None
+    with _LOCK:
+        _cached_env = None
+        _cached_plan = None
 
 
 def validate_env() -> None:

@@ -53,6 +53,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-host-lane", action="store_true",
                    help="disable the host demotion lane (device failures "
                    "then fail the job instead of retrying on the host)")
+    p.add_argument("--fleet-max", type=int, default=None,
+                   help="elastic fleet worker ceiling; > 0 runs the "
+                   "device lane through the chunk-level fleet plane "
+                   "with autoscaling and work-stealing (default: "
+                   "RACON_TPU_FLEET_MAX_WORKERS, 0 = in-process device "
+                   "lane)")
+    p.add_argument("--fleet-min", type=int, default=None,
+                   help="elastic fleet worker floor (default: "
+                   "RACON_TPU_FLEET_MIN_WORKERS)")
     p.add_argument("-m", "--match", type=int, default=3,
                    help="match score to warm kernels for (default 3)")
     p.add_argument("-x", "--mismatch", type=int, default=-5,
@@ -120,7 +129,8 @@ def main(argv=None) -> int:
         warm=False if args.no_warm else None,
         warm_window_lengths=tuple(args.warm_window or (500,)),
         warm_scores=(args.match, args.mismatch, args.gap),
-        host_lane=not args.no_host_lane)
+        host_lane=not args.no_host_lane,
+        fleet_min=args.fleet_min, fleet_max=args.fleet_max)
 
     from ..obs import flight
     flight.set_role("serve")

@@ -71,6 +71,7 @@ class ServeClient:
                args: Optional[dict] = None, include_unpolished: bool = False,
                backend: str = "", job_id: str = "",
                submitter: str = "", window_budget: int = 0,
+               priority: int = 0,
                trace: Optional[dict] = None) -> str:
         resp = self.rpc(op="submit", sequences=sequences, overlaps=overlaps,
                         target=target, args=args or {},
@@ -78,6 +79,7 @@ class ServeClient:
                         backend=backend, job_id=job_id,
                         submitter=submitter or f"pid{os.getpid()}",
                         window_budget=window_budget,
+                        priority=priority,
                         trace=trace)
         return resp["job_id"]
 

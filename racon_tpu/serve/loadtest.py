@@ -76,37 +76,56 @@ def spawn_daemon(state_dir: str, backend: str = "tpu",
 
 def run_loadtest(port: int, paths: dict, jobs: int, clients: int,
                  polish_args: Optional[dict] = None,
-                 backend: str = "", timeout: float = 1200.0) -> dict:
-    """Drive an already-running daemon with `jobs` identical synthetic
-    jobs from `clients` concurrent client threads; returns the summary
-    dict (see module docstring for the metrics)."""
+                 backend: str = "", timeout: float = 1200.0,
+                 tenants: int = 1, priority_levels: int = 1,
+                 profiles: Optional[List[dict]] = None) -> dict:
+    """Drive an already-running daemon with `jobs` synthetic jobs from
+    `clients` concurrent client threads; returns the summary dict (see
+    module docstring for the metrics).
+
+    Mixed multi-tenant load: jobs round-robin over `tenants` submitter
+    identities and `priority_levels` priority lanes, and `profiles` (a
+    list of polish-arg dicts layered over `polish_args`) varies the job
+    shape — together they exercise the scheduler's tenant fairness,
+    quota, and priority paths, not just its throughput."""
     polish_args = polish_args or {}
     clients = max(1, min(clients, jobs))
+    tenants = max(1, tenants)
+    priority_levels = max(1, priority_levels)
     per_job: List[Optional[dict]] = [None] * jobs
     errors: List[str] = []
     barrier = threading.Barrier(clients)
+    t_start = time.monotonic()
 
     def client_loop(ci: int) -> None:
         try:
             with ServeClient(port, timeout=timeout) as c:
                 barrier.wait()
                 for ji in range(ci, jobs, clients):
+                    tenant = f"tenant{ji % tenants}"
+                    priority = ji % priority_levels
+                    args = dict(polish_args)
+                    if profiles:
+                        args.update(profiles[ji % len(profiles)])
                     t0 = time.monotonic()
                     job_id = c.submit(paths["reads"], paths["overlaps"],
-                                      paths["draft"], args=polish_args,
+                                      paths["draft"], args=args,
                                       backend=backend,
-                                      submitter=f"loadtest-c{ci}")
+                                      submitter=tenant, priority=priority)
                     resp = c.wait(job_id, timeout=timeout)
                     res = resp.get("result") or {}
                     per_job[ji] = {
                         "job_id": job_id,
                         "latency_s": round(time.monotonic() - t0, 4),
+                        "t_done": round(time.monotonic() - t_start, 4),
                         "service_s": res.get("wall_s"),
                         "cold": bool(res.get("cold")),
                         "kernel_builds": res.get("kernel_builds"),
                         "polished_bp": res.get("polished_bp", 0),
                         "backend": res.get("backend"),
                         "client": ci,
+                        "tenant": tenant,
+                        "priority": priority,
                     }
         except (ServeError, OSError, threading.BrokenBarrierError) as e:
             errors.append(f"client {ci}: {type(e).__name__}: {e}")
@@ -123,12 +142,12 @@ def run_loadtest(port: int, paths: dict, jobs: int, clients: int,
                 while not stop_poll.is_set():
                     resp = c.stats()
                     resp.pop("ok", None)
+                    resp["t"] = round(time.monotonic() - t_start, 3)
                     stats_samples.append(resp)  # concurrency: append-only; read after join
                     stop_poll.wait(1.0)
         except (ServeError, OSError):
             return  # polling is observation; it must never fail the run
 
-    t_start = time.monotonic()
     threads = [threading.Thread(target=client_loop, args=(ci,),
                                 name=f"loadtest-c{ci}", daemon=True)
                for ci in range(clients)]
@@ -158,6 +177,8 @@ def run_loadtest(port: int, paths: dict, jobs: int, clients: int,
     summary = {
         "jobs": jobs,
         "clients": clients,
+        "tenants": tenants,
+        "priority_levels": priority_levels,
         "completed": len(completed),
         "errors": errors,
         "makespan_s": round(makespan, 4),
@@ -191,9 +212,70 @@ def run_loadtest(port: int, paths: dict, jobs: int, clients: int,
                  for s in stats_samples), default=0),
             "last": stats_samples[-1] if stats_samples else None,
         },
+        # elastic pool-size timeline + saturation curve: how worker
+        # count, completion rate, and tail latency evolved over the run
+        # (pool is None when the daemon ran without a fleet plane)
+        "pool": pool_series(stats_samples),
+        "curve": saturation_curve(completed, stats_samples, makespan),
         "per_job": completed,
     }
     return summary
+
+
+def pool_series(stats_samples: List[dict]) -> Optional[dict]:
+    """Elastic-pool timeline from the scraped stats samples: worker
+    live/active counts over time plus the plane's own size timeline
+    from the final sample.  None when no sample carried a fleet
+    snapshot (daemon running without a plane)."""
+    fleet = [(s["t"], s["fleet"]) for s in stats_samples
+             if isinstance(s.get("fleet"), dict)]
+    if not fleet:
+        return None
+    last = fleet[-1][1]
+    return {
+        "min": last.get("min_workers"),
+        "max": last.get("max_workers"),
+        "timeline": last.get("timeline"),
+        "samples": [{"t": t,
+                     "live": (f.get("workers") or {}).get("live"),
+                     "active": (f.get("workers") or {}).get("active"),
+                     "chunks_pending": f.get("chunks_pending")}
+                    for t, f in fleet[-300:]],
+    }
+
+
+def saturation_curve(completed: List[dict], stats_samples: List[dict],
+                     makespan: float, buckets: int = 12) -> List[dict]:
+    """Time-bucketed saturation curve over the run: per bucket the
+    completion rate (jobs/s), the p99 end-to-end latency of the jobs
+    that finished in it, the peak total queued depth, and the peak live
+    worker count (None without a fleet plane)."""
+    if makespan <= 0 or not completed:
+        return []
+    buckets = max(1, min(buckets, len(completed)))
+    step = makespan / buckets
+    curve = []
+    for b in range(buckets):
+        lo, hi = b * step, (b + 1) * step
+        done = [r for r in completed
+                if lo <= r["t_done"] < hi or (b == buckets - 1
+                                              and r["t_done"] >= lo)]
+        in_bucket = [s for s in stats_samples if lo <= s["t"] < hi]
+        workers = [((s.get("fleet") or {}).get("workers") or {}).get("live")
+                   for s in in_bucket]
+        workers = [w for w in workers if w is not None]
+        curve.append({
+            "t_end_s": round(hi, 3),
+            "jobs_done": len(done),
+            "jobs_per_s": round(len(done) / step, 4),
+            "p99_s": (percentile([r["latency_s"] for r in done], 99)
+                      if done else None),
+            "max_queued": max(
+                (sum((s.get("queued") or {}).values())
+                 for s in in_bucket), default=0),
+            "workers": max(workers) if workers else None,
+        })
+    return curve
 
 
 # -- docs -------------------------------------------------------------------
@@ -201,11 +283,15 @@ def run_loadtest(port: int, paths: dict, jobs: int, clients: int,
 def render_markdown(summary: dict, workload: str) -> str:
     lat = summary["latency_s"]
     svc = summary["service_s"]
+    mix = ""
+    if summary.get("tenants", 1) > 1 or summary.get("priority_levels", 1) > 1:
+        mix = (f", mixed over {summary['tenants']} tenants / "
+               f"{summary['priority_levels']} priority levels")
     lines = [
         DOCS_BEGIN,
         f"Measured by `python -m racon_tpu.serve.loadtest` — {workload}; "
         f"{summary['jobs']} jobs from {summary['clients']} concurrent "
-        f"clients against one daemon:",
+        f"clients against one daemon{mix}:",
         "",
         "| metric | value |",
         "|---|---|",
@@ -226,8 +312,36 @@ def render_markdown(summary: dict, workload: str) -> str:
         + (f"{svc['cold_warm_delta']:.2f} s |"
            if svc["cold_warm_delta"] is not None else "n/a |"),
         f"| kernel builds in warm jobs | {summary['warm_kernel_builds']} |",
-        DOCS_END,
     ]
+    pool = summary.get("pool")
+    if pool and pool.get("max") is not None:
+        lives = [s["live"] for s in pool.get("samples", [])
+                 if s.get("live") is not None]
+        lines.append(f"| elastic fleet workers (floor..ceiling) | "
+                     f"{pool.get('min')}..{pool.get('max')} |")
+        if lives:
+            lines.append(f"| worker count seen (min..peak) | "
+                         f"{min(lives)}..{max(lives)} |")
+    curve = summary.get("curve") or []
+    if len(curve) > 1:
+        lines += [
+            "",
+            "Saturation curve (time-bucketed over the makespan — "
+            "completion rate, tail latency, queue depth, and elastic "
+            "worker count as the run progressed):",
+            "",
+            "| t (s) | jobs/s | p99 latency (s) | peak queued | workers |",
+            "|---|---|---|---|---|",
+        ]
+        for row in curve:
+            p99 = f"{row['p99_s']:.2f}" if row["p99_s"] is not None \
+                else "n/a"
+            workers = row["workers"] if row["workers"] is not None \
+                else "n/a"
+            lines.append(
+                f"| {row['t_end_s']:.1f} | {row['jobs_per_s']:.2f} | "
+                f"{p99} | {row['max_queued']} | {workers} |")
+    lines.append(DOCS_END)
     return "\n".join(lines)
 
 
@@ -260,6 +374,25 @@ def main(argv=None) -> int:
         "+ the cold-vs-warm first-job delta.")
     p.add_argument("--jobs", type=int, default=6)
     p.add_argument("--clients", type=int, default=3)
+    p.add_argument("--tenants", type=int, default=1,
+                   help="round-robin jobs over this many submitter "
+                   "identities (exercises tenant fairness + quotas)")
+    p.add_argument("--priority-levels", type=int, default=1,
+                   help="round-robin jobs over priorities 0..N-1 "
+                   "(exercises the priority lanes)")
+    p.add_argument("--mix-profiles", action="store_true",
+                   help="alternate job shapes (full vs half window "
+                   "length) so the load is not uniform")
+    p.add_argument("--fleet-max", type=int, default=None,
+                   help="spawn the daemon with this elastic-fleet "
+                   "worker ceiling (> 0 routes device jobs through "
+                   "the chunk-level fleet plane)")
+    p.add_argument("--fleet-min", type=int, default=None,
+                   help="spawned daemon's fleet worker floor")
+    p.add_argument("--queue-depth", type=int, default=None,
+                   help="spawned daemon's queued-job admission cap")
+    p.add_argument("--max-jobs", type=int, default=None,
+                   help="spawned daemon's unfinished-job admission cap")
     p.add_argument("--port", type=int, default=None,
                    help="drive an already-running daemon on this port "
                    "(default: spawn a fresh one)")
@@ -292,17 +425,32 @@ def main(argv=None) -> int:
     workload = (f"{args.mbp} Mbp draft x {args.coverage}x coverage, "
                 f"-w {args.window_length}, backend {args.backend}")
 
+    extra: List[str] = []
+    for flag, val in (("--fleet-max", args.fleet_max),
+                      ("--fleet-min", args.fleet_min),
+                      ("--queue-depth", args.queue_depth),
+                      ("--max-jobs", args.max_jobs)):
+        if val is not None:
+            extra += [flag, str(val)]
+    profiles = None
+    if args.mix_profiles:
+        profiles = [{}, {"window_length": max(50, args.window_length // 2)}]
+        workload += ", mixed profiles"
     proc = None
     if args.port is None:
         proc = spawn_daemon(os.path.join(workdir, "state"), args.backend,
-                            window_length=args.window_length)
+                            window_length=args.window_length,
+                            extra_args=extra or None)
         with open(os.path.join(workdir, "state", "serve.json")) as f:
             port = json.load(f)["port"]
     else:
         port = args.port
     try:
         summary = run_loadtest(port, paths, args.jobs, args.clients,
-                               polish_args=polish_args)
+                               polish_args=polish_args,
+                               tenants=args.tenants,
+                               priority_levels=args.priority_levels,
+                               profiles=profiles)
     finally:
         if proc is not None:
             try:

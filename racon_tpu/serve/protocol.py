@@ -89,7 +89,7 @@ PROTOCOL = {
         "submit": {"req": ("sequences", "overlaps", "target"),
                    "opt": ("args", "include_unpolished", "backend",
                            "job_id", "submitter", "window_budget",
-                           "trace"),
+                           "priority", "trace"),
                    "resp": ("job_id", "lane", "demotions")},
         "status": {"req": ("job_id",), "opt": (),
                    "resp": ("job_id", "state", "lane", "submitter",
@@ -105,7 +105,8 @@ PROTOCOL = {
                             "running_s")},
         "stats": {"req": (), "opt": (),
                   "resp": ("jobs", "queued", "queue_depth", "max_jobs",
-                           "window_budget", "session", "telemetry")},
+                           "window_budget", "session", "telemetry",
+                           "admission", "fleet")},
         "shutdown": {"req": (), "opt": (), "resp": ("bye",)},
     },
     "distrib": {

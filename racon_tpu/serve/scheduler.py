@@ -12,13 +12,31 @@ where a window falls ls → v2 → xla → host, a whole job falls
 device-lane → host-lane.
 
 Admission control bounds what the daemon will hold: a queue-depth cap on
-not-yet-running jobs, a max-jobs cap on everything unfinished, and a
-per-job window budget — a job whose estimated window count exceeds the
-budget is demoted to the host lane at submit time instead of occupying
-the device queue (an overloaded tier demotes work, it does not stall the
-queue).  Fairness is per-submitter round-robin: each submitter has its
-own FIFO; the scheduler serves submitters in rotation so one flooding
-client cannot starve the rest.
+not-yet-running jobs, a max-jobs cap on everything unfinished, an
+optional per-tenant quota (``RACON_TPU_FLEET_TENANT_QUOTA``), and a
+window budget enforced in two steps — a job whose estimated window
+count exceeds the budget is demoted to the host lane at submit time
+(an overloaded tier demotes work, it does not stall the queue), and a
+job that fits alone but would push the device lane's *aggregate*
+reserved windows over the budget is **shed** to the host lane; when the
+host lane itself is saturated the submit is rejected.  The ladder is
+always shed → host lane → reject, in that order.  The estimate is file
+I/O and runs outside the scheduler lock; the check-and-reserve against
+the aggregate happens atomically under it, so concurrent submits cannot
+both squeeze into the same budget headroom.  Fairness is per-submitter
+round-robin with priority lanes (fleet/queues.py): each submitter has
+its own FIFOs; the scheduler serves the highest priority present and
+rotates submitters within it, so one flooding client cannot starve the
+rest and a high-priority job outranks lower lanes without starving
+other tenants at its own level.
+
+Elastic fleet: with a ``FleetPlane`` attached (fleet/plane.py;
+``RACON_TPU_FLEET_MAX_WORKERS`` > 0), the device lane stops running
+jobs in-process and instead feeds them to the plane, which splits each
+into chunks dispatched across an autoscaled worker pool — several jobs
+in flight at once, so idle workers steal chunks across jobs.  A plane
+failure demotes the job to the host lane exactly like an in-process
+device failure; output is byte-identical on every path.
 
 Failure handling mirrors the lattice, too: a job that raises on the
 device lane is demoted to the host lane (recorded in its
@@ -42,10 +60,11 @@ import subprocess
 import sys
 import threading
 import time
-from collections import deque
 from typing import Dict, List, Optional
 
 from .. import obs
+from ..fleet import fleet_tenant_quota
+from ..fleet.queues import TenantQueues
 from .session import (JobCancelled, JobSpec, PolishSession, serve_max_jobs,
                       serve_queue_depth, serve_window_budget)
 
@@ -123,7 +142,9 @@ class Scheduler:
                  queue_depth: Optional[int] = None,
                  max_jobs: Optional[int] = None,
                  window_budget: Optional[int] = None,
-                 host_lane: bool = True):
+                 host_lane: bool = True,
+                 plane=None,
+                 tenant_quota: Optional[int] = None):
         self.session = session
         self.queue_depth = (serve_queue_depth() if queue_depth is None
                             else queue_depth)
@@ -131,10 +152,18 @@ class Scheduler:
         self.window_budget = (serve_window_budget() if window_budget is None
                               else window_budget)
         self.host_lane = host_lane
+        self.plane = plane   # FleetPlane, or None for in-process device
+        self.tenant_quota = (fleet_tenant_quota() if tenant_quota is None
+                             else tenant_quota)
         self._jobs: Dict[str, Job] = {}
-        # lane -> submitter -> FIFO; _rr is the submitter rotation.
-        self._queues: Dict[str, Dict[str, deque]] = {ln: {} for ln in LANES}
-        self._rr: Dict[str, List[str]] = {ln: [] for ln in LANES}
+        # lane -> per-tenant priority queues (fleet/queues.py)
+        self._queues: Dict[str, TenantQueues] = {ln: TenantQueues()
+                                                 for ln in LANES}
+        # device-lane window reservations by job id: the aggregate the
+        # shed check holds against, reserved at admit under _cv and
+        # released when the job leaves the device lane
+        self._reserved: Dict[str, int] = {}
+        self.admission: Dict[str, int] = {}   # demoted/shed/rejected/...
         self._cv = threading.Condition()
         self._stop = False
         self._counter = 0
@@ -234,21 +263,37 @@ class Scheduler:
 
     def submit(self, spec: JobSpec) -> Job:
         spec.validate()
+        # the size estimate is file I/O: run it BEFORE taking the lock
+        # (a slow disk must not stall every other submit/finish), then
+        # check-and-reserve atomically under it — two concurrent submits
+        # can never both fit into the same budget headroom
+        est = self._estimate(spec)
         with self._cv:
             if self._stop:
                 raise AdmissionError("daemon is shutting down")
             unfinished = sum(1 for j in self._jobs.values()
                              if j.state not in TERMINAL)
             if unfinished >= self.max_jobs:
+                self._admission_count("rejected_capacity")
                 raise AdmissionError(
                     f"at capacity: {unfinished} unfinished jobs "
                     f"(RACON_TPU_SERVE_MAX_JOBS={self.max_jobs})")
-            queued = sum(len(q) for lane in self._queues.values()
-                         for q in lane.values())
+            queued = sum(len(q) for q in self._queues.values())
             if queued >= self.queue_depth:
+                self._admission_count("rejected_queue_full")
                 raise AdmissionError(
                     f"queue full: {queued} queued jobs "
                     f"(RACON_TPU_SERVE_QUEUE_DEPTH={self.queue_depth})")
+            if self.tenant_quota > 0:
+                held = sum(1 for j in self._jobs.values()
+                           if j.spec.submitter == spec.submitter
+                           and j.state not in TERMINAL)
+                if held >= self.tenant_quota:
+                    self._admission_count("rejected_quota")
+                    raise AdmissionError(
+                        f"tenant quota: submitter {spec.submitter!r} "
+                        f"holds {held} unfinished jobs (RACON_TPU_FLEET_"
+                        f"TENANT_QUOTA={self.tenant_quota})")
             job_id = spec.job_id
             if job_id:
                 prior = self._jobs.get(job_id)
@@ -263,32 +308,77 @@ class Scheduler:
                         break
                 spec.job_id = job_id
             job = Job(spec, job_id)
-            lane = self._admission_lane(job)
+            lane = self._admission_lane(job, est)
             self._jobs[job_id] = job
             self._enqueue(lane, job)
             self._persist_spec(job)
             self._cv.notify_all()
             return job
 
-    def _admission_lane(self, job: Job) -> str:
+    def _estimate(self, spec: JobSpec) -> Optional[int]:
+        """Window estimate for budget admission; None when the budget
+        machinery does not apply to this spec.  Lock-free (file I/O)."""
+        if not self.host_lane:
+            return None
+        if ((spec.backend or self.session.backend) == "cpu"
+                and self.plane is None):
+            return None
+        if (spec.window_budget or self.window_budget) <= 0:
+            return None
+        w = spec.polish_args()["window_length"]
+        return estimate_windows(spec.target, w)
+
+    def _admission_count(self, name: str, n: int = 1) -> None:
+        # call with self._cv held
+        self.admission[name] = self.admission.get(name, 0) + n
+
+    def _admission_lane(self, job: Job, est: Optional[int]) -> str:
+        """Lane decision + window reservation (call with _cv held).
+        The ladder: per-job budget demote, then aggregate shed, then —
+        if the host lane cannot absorb the fallout either — reject."""
         spec = job.spec
         if not self.host_lane:
             return "device"
-        if (spec.backend or self.session.backend) == "cpu":
+        if ((spec.backend or self.session.backend) == "cpu"
+                and self.plane is None):
+            # in-process device lane has nothing to offer a cpu job; a
+            # fleet plane does (worker processes), so this shortcut only
+            # applies without one
             job.lane = "host"
             return "host"
         budget = spec.window_budget or self.window_budget
-        if budget > 0:
-            w = spec.polish_args()["window_length"]
-            est = estimate_windows(spec.target, w)
-            if est is not None and est > budget:
-                job.lane = "host"
-                job.demotions.append({
-                    "from": "device", "to": "host",
-                    "cause": f"window budget: ~{est} windows > "
-                             f"budget {budget}"})
-                return "host"
-        return "device"
+        to_host: Optional[str] = None
+        if budget > 0 and est is not None:
+            if est > budget:
+                to_host = (f"window budget: ~{est} windows > "
+                           f"budget {budget}")
+                self._admission_count("demoted_budget")
+            else:
+                reserved = sum(self._reserved.values())
+                if reserved + est > budget:
+                    # the job fits alone but not on top of what the
+                    # device lane already holds: shed it
+                    to_host = (f"shed: ~{est} windows would push the "
+                               f"device lane to {reserved + est} "
+                               f"reserved > budget {budget}")
+                    self._admission_count("shed")
+        if to_host is None:
+            if est is not None:
+                self._reserved[job.id] = est
+            return "device"
+        if len(self._queues["host"]) >= self.queue_depth:
+            # the bottom of the ladder: host lane saturated too
+            self._admission_count("rejected_host_saturated")
+            raise AdmissionError(
+                f"host lane saturated ({len(self._queues['host'])} "
+                f"queued) and the device lane is over budget — "
+                f"resubmit later ({to_host})")
+        job.lane = "host"
+        job.demotions.append({"from": "device", "to": "host",
+                              "cause": to_host})
+        obs.event("serve.shed" if to_host.startswith("shed") else
+                  "serve.demote", job=job.id, cause=to_host)
+        return "host"
 
     def get(self, job_id: str) -> Job:
         with self._cv:
@@ -307,9 +397,8 @@ class Scheduler:
         with self._cv:
             if job.state == "queued":
                 for lane in LANES:
-                    q = self._queues[lane].get(job.spec.submitter)
-                    if q is not None and job in q:
-                        q.remove(job)
+                    self._queues[lane].remove(job.spec.submitter, job)
+                self._reserved.pop(job.id, None)
                 job.state = "cancelled"
                 job.error = "cancelled while queued"
                 job.t_end = time.monotonic()
@@ -317,48 +406,52 @@ class Scheduler:
                 self._persist_result(job)
                 return job.as_status()
         job.cancel.set()
+        # plane jobs: propagate outside _cv (the plane fires on_done ->
+        # _finish, which takes _cv itself)
+        if self.plane is not None and job.lane == "device":
+            self.plane.cancel_job(job_id)
         return job.as_status()
 
     def stats(self) -> dict:
+        # the plane snapshot takes the plane's lock; grab it outside
+        # ours so the two condition variables never nest
+        fleet = self.plane.snapshot() if self.plane is not None else None
         with self._cv:
             by_state: Dict[str, int] = {}
             for j in self._jobs.values():
                 by_state[j.state] = by_state.get(j.state, 0) + 1
-            queued = {lane: sum(len(q) for q in lanes.values())
-                      for lane, lanes in self._queues.items()}
-        return {
+            queued = {lane: len(q) for lane, q in self._queues.items()}
+            admission = dict(self.admission)
+            admission["reserved_windows"] = sum(self._reserved.values())
+            admission["by_tenant"] = {
+                lane: q.per_tenant() for lane, q in self._queues.items()}
+        out = {
             "jobs": by_state,
             "queued": queued,
             "queue_depth": self.queue_depth,
             "max_jobs": self.max_jobs,
             "window_budget": self.window_budget,
+            "admission": admission,
             "session": self.session.stats(),
             # recent metrics-snapshot ring (obs.telemetry_tick entries,
             # stamped per finished job) — what `--stats-watch` polls
             "telemetry": obs.telemetry(last=8),
         }
+        if fleet is not None:
+            out["fleet"] = fleet
+        return out
 
     # -- queue mechanics (call with self._cv held) -------------------------
 
     def _enqueue(self, lane: str, job: Job) -> None:
-        sub = job.spec.submitter
-        q = self._queues[lane].get(sub)
-        if q is None:
-            q = self._queues[lane][sub] = deque()
-            self._rr[lane].append(sub)
-        q.append(job)
+        self._queues[lane].push(job.spec.submitter, job,
+                                job.spec.priority)
 
     def _pop(self, lane: str) -> Optional[Job]:
-        """Next job for a lane: first submitter in the rotation with
-        queued work; the served submitter moves to the back, so bursts
-        from one client interleave with everyone else's jobs."""
-        rr = self._rr[lane]
-        for i, sub in enumerate(rr):
-            q = self._queues[lane][sub]
-            if q:
-                rr.append(rr.pop(i))
-                return q.popleft()
-        return None
+        """Next job for a lane: highest priority present, round-robin
+        among the submitters holding it (fleet/queues.py) — bursts from
+        one client interleave with everyone else's jobs."""
+        return self._queues[lane].pop()
 
     # -- workers -----------------------------------------------------------
 
@@ -374,6 +467,12 @@ class Scheduler:
                 job.state = "running"
                 job.lane = lane
                 job.t_start = time.monotonic()
+            if lane == "device" and self.plane is not None:
+                # elastic fleet path: hand the job to the plane and go
+                # straight back to the queue — several jobs in flight at
+                # once is what makes cross-job stealing possible
+                self._dispatch_to_plane(job)
+                continue
             try:
                 if lane == "device":
                     result = self.session.run_job(job.spec,
@@ -394,11 +493,48 @@ class Scheduler:
             else:
                 self._finish(job, "done", result=result)
 
+    def _dispatch_to_plane(self, job: Job) -> None:
+        """Submit one popped job to the fleet plane, non-blocking.  The
+        plane's on_done callback (fired off its lock, on a fleet thread)
+        re-enters _finish/_demote exactly like the in-process path."""
+        spec = job.spec
+
+        def on_done(state: str, result: Optional[dict],
+                    error: Optional[str]) -> None:
+            if state == "done":
+                self._finish(job, "done", result=result)
+            elif state == "cancelled":
+                self._finish(job, "cancelled",
+                             error=error or "cancelled mid-run")
+            elif self.host_lane and not job.cancel.is_set():
+                self._demote(job, RuntimeError(error or "fleet failure"))
+            else:
+                self._finish(job, "failed",
+                             error=error or "fleet failure")
+
+        try:
+            self.plane.submit_job(
+                job.id, spec.sequences, spec.overlaps, spec.target,
+                spec.polish_args(), spec.include_unpolished,
+                spec.backend or self.session.backend,
+                workdir=self.session.job_dir(job.id),
+                tenant=spec.submitter, priority=spec.priority,
+                on_done=on_done)
+        except Exception as e:  # noqa: BLE001 — a plane that cannot
+            # admit (stopping, duplicate id) degrades like any device
+            # failure: host lane if there is one, else the job fails
+            if self.host_lane and not job.cancel.is_set():
+                self._demote(job, e)
+            else:
+                self._finish(job, "failed",
+                             error=f"{type(e).__name__}: {e}")
+
     def _demote(self, job: Job, exc: BaseException) -> None:
         """Device-lane failure: re-queue on the host lane (the job-level
         degradation step).  Output stays byte-identical — the host lane
         is the oracle path."""
         with self._cv:
+            self._reserved.pop(job.id, None)
             job.demotions.append({
                 "from": "device", "to": "host",
                 "cause": f"{type(exc).__name__}: {exc}"})
@@ -413,6 +549,7 @@ class Scheduler:
     def _finish(self, job: Job, state: str, result: Optional[dict] = None,
                 error: Optional[str] = None) -> None:
         with self._cv:
+            self._reserved.pop(job.id, None)
             job.state = state
             job.result = result
             job.error = error

@@ -50,14 +50,34 @@ class ServeDaemon:
                  warm: Optional[bool] = None,
                  warm_window_lengths=(500,),
                  warm_scores=(3, -5, -4),
-                 host_lane: bool = True):
+                 host_lane: bool = True,
+                 fleet_min: Optional[int] = None,
+                 fleet_max: Optional[int] = None):
         self.state_dir = state_dir
         os.makedirs(state_dir, exist_ok=True)
         self.session = PolishSession(state_dir, backend=backend)
+        # elastic fleet: with a worker ceiling > 0 the device lane runs
+        # through a FleetPlane (chunk-level control plane with an
+        # autoscaled worker pool) instead of in-process
+        from ..fleet import fleet_max_workers, fleet_min_workers
+        resolved_max = fleet_max_workers() if fleet_max is None else fleet_max
+        self.plane = None
+        if resolved_max > 0:
+            from ..fleet.plane import FleetPlane
+            fleet_dir = os.path.join(state_dir, "fleet")
+            self.plane = FleetPlane(
+                workdir=fleet_dir,
+                min_workers=(fleet_min_workers() if fleet_min is None
+                             else fleet_min),
+                max_workers=resolved_max,
+                backend=backend,
+                trace_path=os.path.join(fleet_dir, "trace.json"),
+                report_path=os.path.join(fleet_dir, "report.json"))
         self.scheduler = Scheduler(self.session, queue_depth=queue_depth,
                                    max_jobs=max_jobs,
                                    window_budget=window_budget,
-                                   host_lane=host_lane)
+                                   host_lane=host_lane,
+                                   plane=self.plane)
         self._warm = warm
         self._warm_window_lengths = warm_window_lengths
         self._warm_scores = warm_scores
@@ -81,13 +101,21 @@ class ServeDaemon:
                        "backend": self.session.backend}, f)
             f.write("\n")
         warm = serve_warmup_enabled() if self._warm is None else self._warm
-        if warm:
+        if warm and self.plane is None:
+            # with the plane on, device jobs run in worker processes —
+            # warming the in-process session would compile kernels
+            # nothing ever uses
             m, x, g = self._warm_scores
             wall = self.session.warm(self._warm_window_lengths, m, x, g)
             if wall:
                 print(f"[racon_tpu::serve] warmed consensus geometries "
                       f"{sorted(self.session.warmed)} in {wall:.2f}s",
                       file=sys.stderr)
+        if self.plane is not None:
+            self.plane.start()
+            print(f"[racon_tpu::serve] fleet plane up on port "
+                  f"{self.plane.port} (workers {self.plane.min_workers}"
+                  f"..{self.plane.max_workers})", file=sys.stderr)
         self.scheduler.start()
         recovered = self.scheduler.recover()
         if recovered:
@@ -106,6 +134,7 @@ class ServeDaemon:
               file=sys.stderr)
         self._stopping.wait()
         self.scheduler.shutdown(wait=True)
+        self._stop_plane()
 
     def stop(self, wait: bool = True) -> None:
         if not self._stopping.is_set():
@@ -116,6 +145,15 @@ class ServeDaemon:
                 pass
         if wait:
             self.scheduler.shutdown(wait=True)
+            self._stop_plane()
+
+    def _stop_plane(self) -> None:
+        """Drain the fleet plane: stamp the scheduler's admission ledger
+        into the fleet report, then stop (writes report + trace)."""
+        if self.plane is None:
+            return
+        self.plane.phase.extra["admission"] = dict(self.scheduler.admission)
+        self.plane.stop()
 
     # -- accept / connection handling --------------------------------------
 

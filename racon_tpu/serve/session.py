@@ -73,6 +73,9 @@ class JobSpec:
     job_id: str = ""
     submitter: str = "local"
     window_budget: int = 0
+    #: Priority lane (higher serves first; fairness still rotates
+    #: tenants within a lane — fleet/queues.py).
+    priority: int = 0
     #: Optional trace context ({"trace_id", "parent"}) from the
     #: submitter, so the job's spans parent under the caller's timeline
     #: when the traces are merged (obs/context.py).
@@ -112,6 +115,7 @@ class JobSpec:
             "job_id": self.job_id,
             "submitter": self.submitter,
             "window_budget": self.window_budget,
+            "priority": self.priority,
             "trace": dict(self.trace) if self.trace else None,
         }
 
@@ -119,7 +123,8 @@ class JobSpec:
     def from_dict(cls, d: dict) -> "JobSpec":
         unknown = sorted(set(d) - {
             "sequences", "overlaps", "target", "args", "include_unpolished",
-            "backend", "job_id", "submitter", "window_budget", "trace"})
+            "backend", "job_id", "submitter", "window_budget", "priority",
+            "trace"})
         if unknown:
             raise ValueError(f"unknown job field(s): {', '.join(unknown)}")
         for key in ("sequences", "overlaps", "target"):
@@ -139,6 +144,7 @@ class JobSpec:
             job_id=str(d.get("job_id") or ""),
             submitter=str(d.get("submitter") or "local"),
             window_budget=int(d.get("window_budget") or 0),
+            priority=int(d.get("priority") or 0),
             trace=(dict(d.get("trace"))
                    if isinstance(d.get("trace"), dict) else None),
         )

@@ -303,6 +303,7 @@ def test_bench_distrib_entry_normalizes_as_fixed_point():
                     "redispatches": 1, "journal_replayed": 2},
         "fleet": {"workers": {"0": {"chunks": 6}},
                   "queueing_p95_s": 0.01, "staleness_max_s": 0.2},
+        "pool": {"min": 3, "max": 3, "timeline": [[0.0, 3]]},
         "mbp": 0.5, "input": "paf", "profile": "distrib-ont",
     }
     assert normalize_entry(dict(entry)) == entry
@@ -312,6 +313,9 @@ def test_bench_distrib_entry_normalizes_as_fixed_point():
     # pre-telemetry distrib entries get the explicit "not scraped" null
     legacy = {k: v for k, v in entry.items() if k != "fleet"}
     assert normalize_entry(legacy)["fleet"] is None
+    # pre-elastic-pool entries get the explicit "no timeline" null
+    legacy = {k: v for k, v in entry.items() if k != "pool"}
+    assert normalize_entry(legacy)["pool"] is None
 
 
 # ------------------------------------------------ integration: real fleets

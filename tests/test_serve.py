@@ -581,6 +581,7 @@ def test_bench_serve_entry_normalizes_as_fixed_point():
         "serve": {"jobs": 4, "clients": 2,
                   "latency_s": {"p50": 1, "p95": 2, "p99": 3}},
         "fleet": {"samples": 3, "max_queued": 2, "last": None},
+        "pool": {"min": 1, "max": 3, "timeline": [[0.0, 1], [1.5, 3]]},
         "mbp": 0.5, "input": "paf", "profile": "serve-ont",
     }
     assert normalize_entry(dict(entry)) == entry
@@ -590,6 +591,9 @@ def test_bench_serve_entry_normalizes_as_fixed_point():
     # pre-telemetry serve entries get the explicit "not scraped" null
     legacy = {k: v for k, v in entry.items() if k != "fleet"}
     assert normalize_entry(legacy)["fleet"] is None
+    # pre-elastic-pool entries get the explicit "no timeline" null
+    legacy = {k: v for k, v in entry.items() if k != "pool"}
+    assert normalize_entry(legacy)["pool"] is None
 
 
 def test_cli_serve_subcommand_dispatches():
