@@ -49,6 +49,75 @@ def _sanitize_report(path: str, as_json: bool) -> int:
     return 0
 
 
+_MODEL_REL = "racon_tpu/analysis/protocol/model.py"
+
+
+def _mc_config(args):
+    """A model Config from the --mc-* knobs (defaults from Config)."""
+    from .protocol import Config
+    kw = {}
+    if args.mc_workers is not None:
+        kw["workers"] = args.mc_workers
+    if args.mc_chunks is not None:
+        kw["chunks"] = tuple(args.mc_chunks.split(","))
+    if args.mc_retry is not None:
+        kw["retry"] = args.mc_retry
+    if args.mc_faults is not None:
+        kw["faults"] = args.mc_faults
+    if args.mc_budget is not None:
+        kw["budget"] = args.mc_budget
+    if args.mc_submits is not None:
+        kw["submit_ests"] = tuple(int(x) for x
+                                  in args.mc_submits.split(","))
+    return Config(**kw)
+
+
+def _model_check(args):
+    """Run the state exploration; counterexamples come back as ordinary
+    Violations (rule `protocol-invariant`) so the baseline/waiver and
+    exit-code plumbing apply unchanged."""
+    from .protocol import check
+    from .lint import Violation
+
+    res = check(cfg=_mc_config(args), mutation=args.mutate,
+                strategy=args.mc_strategy, max_states=args.mc_max_states,
+                depth=args.mc_depth)
+    violations = [Violation("protocol-invariant", _MODEL_REL, 1,
+                            v.render())
+                  for v in res.violations]
+    if args.emit_schedule:
+        _emit_schedule(args.emit_schedule, res)
+    return res, violations
+
+
+def _emit_schedule(dest: str, res) -> None:
+    """Compile the first counterexample (or a clean worker-death
+    witness run) into a replayable RACON_TPU_FAULT schedule JSON."""
+    from .protocol import replay
+    from .protocol.checker import _fmt_event
+
+    payload = {}
+    try:
+        if res.violations:
+            trace = res.violations[0].trace
+            sched = replay.compile_trace(trace)
+            payload["source"] = res.violations[0].invariant
+        else:
+            trace, sched = replay.witness_trace()
+            payload["source"] = "witness"
+        payload.update(spec=sched.spec, worker=sched.worker,
+                       events=list(sched.events), env=sched.env(),
+                       trace=[_fmt_event(e) for e in trace])
+    except replay.Unreplayable as e:
+        payload = {"error": str(e)}
+    text = json.dumps(payload, indent=2) + "\n"
+    if dest == "-":
+        sys.stdout.write(text)
+    else:
+        with open(dest, "w") as f:
+            f.write(text)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m racon_tpu.analysis",
@@ -65,10 +134,12 @@ def main(argv=None) -> int:
                    help="accept every current violation into the "
                         "baseline file and exit 0")
     p.add_argument("--paths", nargs="+", default=None, metavar="REL",
-                   help="lint only these repo-relative files instead of "
-                        "the whole tree (CI uses this to focus on the "
+                   help="analyze only these repo-relative files instead "
+                        "of the whole tree (CI uses this to focus on the "
                         "modules a change touched); jaxpr audit is "
-                        "skipped when --paths is given")
+                        "skipped when --paths is given.  Default is "
+                        "lint-only; an explicit --concurrency/--contracts "
+                        "runs that audit scoped to the paths")
     p.add_argument("--no-jaxpr", action="store_true",
                    help="skip the jaxpr audit (AST lint only; fast)")
     p.add_argument("--no-lint", action="store_true",
@@ -82,6 +153,48 @@ def main(argv=None) -> int:
                         "drills/docs, fault-point drills/docs, wire-"
                         "protocol field agreement); may be combined "
                         "with --concurrency")
+    p.add_argument("--model-check", action="store_true",
+                   help="run the protocol model checker: exhaust the "
+                        "bounded fleet-lifecycle state space, evaluate "
+                        "the invariant library, print minimal "
+                        "counterexample traces (plus the conformance "
+                        "pass keeping the model honest)")
+    p.add_argument("--mutate", default=None, metavar="N|NAME",
+                   help="model-check self-test: flip one transition "
+                        "guard (index or name, see --list-mutations); "
+                        "the checker must find a violation, so the exit "
+                        "code goes non-zero when the seeded bug is "
+                        "caught (implies --model-check)")
+    p.add_argument("--list-mutations", action="store_true",
+                   help="print every seeded model mutation + the "
+                        "invariant expected to catch it, and exit")
+    p.add_argument("--emit-schedule", default=None, metavar="FILE",
+                   help="with --model-check: compile the first "
+                        "counterexample (or, when clean, a shortest "
+                        "worker-death witness run) into a replayable "
+                        "RACON_TPU_FAULT schedule JSON ('-' = stdout)")
+    p.add_argument("--mc-workers", type=int, default=None,
+                   help="model-check: pool slots (default 2)")
+    p.add_argument("--mc-chunks", default=None, metavar="J,J,...",
+                   help="model-check: job label per chunk, e.g. A,A,B "
+                        "(default)")
+    p.add_argument("--mc-retry", type=int, default=None,
+                   help="model-check: per-chunk retry budget (default 1)")
+    p.add_argument("--mc-faults", type=int, default=None,
+                   help="model-check: injected-fault budget (default 1)")
+    p.add_argument("--mc-budget", type=int, default=None,
+                   help="model-check: window-budget capacity (default 3)")
+    p.add_argument("--mc-submits", default=None, metavar="E,E,...",
+                   help="model-check: window estimate per submitter, "
+                        "e.g. 2,2 (default)")
+    p.add_argument("--mc-strategy", choices=("bfs", "dfs"), default="bfs",
+                   help="model-check: bfs exhausts with minimal traces "
+                        "(default); dfs is the depth-bounded fallback "
+                        "for oversized configs")
+    p.add_argument("--mc-depth", type=int, default=40,
+                   help="model-check: dfs depth bound (default 40)")
+    p.add_argument("--mc-max-states", type=int, default=2_000_000,
+                   help="model-check: state-count cap (default 2e6)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
     p.add_argument("--list-rules", action="store_true",
@@ -125,29 +238,65 @@ def main(argv=None) -> int:
             ("protocol-mismatch",
              "wire-protocol producers/consumers must agree field-for-"
              "field with the declared spec"),
+            ("fault-model",
+             "every fleet-scoped fault point must be claimed by a "
+             "protocol-model transition"),
+            ("model-site",
+             "every protocol-model transition must point at a live "
+             "code site"),
+            ("model-fault",
+             "every protocol-model fault point must exist in "
+             "faults.KNOWN_POINTS"),
+            ("model-coverage",
+             "every fleet-scoped faults.check() site must be claimed "
+             "by a protocol-model transition"),
+            ("protocol-invariant",
+             "no bounded interleaving of the fleet lifecycle may "
+             "violate the invariant library (--model-check)"),
         ):
             print(f"{rid:18s} {doc}")
         return 0
 
+    if args.list_mutations:
+        from .protocol import MUTATIONS
+        for i, (name, doc, expected, overrides) in enumerate(MUTATIONS):
+            extra = f" [config: {overrides}]" if overrides else ""
+            print(f"{i}: {name:28s} -> {expected}{extra}\n"
+                  f"     {doc}")
+        return 0
+
     root = args.repo_root or lint.repo_root_for()
-    audits_selected = args.concurrency or args.contracts
+    model_check = args.model_check or args.mutate is not None
+    audits_selected = args.concurrency or args.contracts or model_check
     violations: List[lint.Violation] = []
     if not audits_selected:
         if not args.no_lint:
             violations.extend(lint.run_lint(root, paths=args.paths))
         if not args.no_jaxpr and args.paths is None:
             violations.extend(jaxpr_audit.run_audit())
-    # Concurrency & contract audits: run when selected explicitly, or as
-    # part of a full-tree run (they are whole-repo analyses, so --paths
-    # runs stay lint-only).
-    if args.concurrency or (not audits_selected and not args.no_lint
-                            and args.paths is None):
-        from .concurrency import run_concurrency
-        violations.extend(run_concurrency(root))
-    if args.contracts or (not audits_selected and not args.no_lint
-                          and args.paths is None):
-        from .concurrency import run_contracts
-        violations.extend(run_contracts(root))
+    # Concurrency & contract audits: an explicit flag always wins
+    # (scoped to --paths when given); otherwise they ride along on
+    # full-tree default runs, and --paths runs stay lint-only.
+    full_default = (not audits_selected and not args.no_lint
+                    and args.paths is None)
+    from .concurrency import UnsupportedScope
+    try:
+        if args.concurrency or full_default:
+            from .concurrency import run_concurrency
+            violations.extend(run_concurrency(root, paths=args.paths))
+        if args.contracts or full_default:
+            from .concurrency import run_contracts
+            violations.extend(run_contracts(root, paths=args.paths))
+    except UnsupportedScope as e:
+        print(f"[analysis] {e}", file=sys.stderr)
+        return 2
+    mc_result = None
+    if model_check or full_default:
+        from .protocol import run_conformance
+        violations.extend(run_conformance(root))
+    if model_check:
+        mc_result, mc_violations = _model_check(args)
+        violations.extend(mc_violations)
 
     baseline_path = args.baseline or os.path.join(
         root, "tools", "lint_baseline.json")
@@ -160,22 +309,53 @@ def main(argv=None) -> int:
     baseline = lint.load_baseline(baseline_path)
     new = lint.filter_baselined(violations, baseline)
 
+    from . import astcache
     if args.as_json:
-        print(json.dumps({
+        payload = {
             "total": len(violations),
             "baselined": len(violations) - len(new),
             "new": [vars(v) for v in new],
-        }, indent=2))
+            "astcache": astcache.stats(),
+        }
+        if mc_result is not None:
+            payload["model_check"] = {
+                "config": mc_result.config.describe(),
+                "mutation": mc_result.mutation,
+                "strategy": mc_result.strategy,
+                "states": mc_result.states,
+                "transitions": mc_result.transitions,
+                "elapsed_s": round(mc_result.elapsed_s, 3),
+                "exhausted": mc_result.exhausted,
+            }
+        print(json.dumps(payload, indent=2))
     else:
         for v in new:
             print(v.render())
         n_base = len(violations) - len(new)
         tail = f" ({n_base} baselined)" if n_base else ""
+        if mc_result is not None:
+            state = ("exhausted" if mc_result.exhausted
+                     else "PARTIAL (cap/depth hit)")
+            mut = (f", mutation={mc_result.mutation}"
+                   if mc_result.mutation else "")
+            print(f"[analysis] model-check: {mc_result.config.describe()}"
+                  f"{mut}: {mc_result.states} states / "
+                  f"{mc_result.transitions} transitions in "
+                  f"{mc_result.elapsed_s:.1f}s ({mc_result.strategy}, "
+                  f"{state})")
         if new:
             print(f"[analysis] FAIL: {len(new)} violation(s){tail}")
         else:
             print(f"[analysis] OK: no new violations{tail}")
-    return 1 if new else 0
+    if new:
+        return 1
+    if mc_result is not None and not mc_result.exhausted:
+        # a clean verdict from a partial exploration proves nothing
+        print("[analysis] model-check did not exhaust the bounded "
+              "space; clean verdict is unsound (raise --mc-max-states "
+              "or --mc-depth, or shrink the config)", file=sys.stderr)
+        return 3
+    return 0
 
 
 if __name__ == "__main__":

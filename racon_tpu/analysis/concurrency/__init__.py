@@ -22,20 +22,55 @@ suppression / CLI plumbing applies unchanged.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 from ..lint import Violation, repo_root_for
 
 
-def run_concurrency(repo_root: Optional[str] = None) -> List[Violation]:
-    """Run the lock-discipline + lock-order audit over one repo tree."""
+class UnsupportedScope(ValueError):
+    """A --paths scope that cannot carry the requested audit."""
+
+
+def run_concurrency(repo_root: Optional[str] = None,
+                    paths: Optional[Sequence[str]] = None
+                    ) -> List[Violation]:
+    """Run the lock-discipline + lock-order audit over one repo tree.
+
+    paths — repo-relative file subset: the model (thread roles, lock
+    sets, call graph) is built from just these files, so cross-file
+    edges to unlisted code are invisible by design.
+    """
     from .locks import audit
+    from .model import Model
     root = repo_root or repo_root_for()
-    return audit(root)
+    model = Model.build(root, list(paths)) if paths is not None else None
+    return audit(root, model=model)
 
 
-def run_contracts(repo_root: Optional[str] = None) -> List[Violation]:
-    """Run the lattice/fault/protocol contract cross-checks."""
-    from .contracts import audit
+def run_contracts(repo_root: Optional[str] = None,
+                  paths: Optional[Sequence[str]] = None
+                  ) -> List[Violation]:
+    """Run the lattice/fault/protocol contract cross-checks.
+
+    paths — repo-relative scope: the audit still reads the whole tree
+    (contracts cross-reference tests/ and docs/), but only violations
+    anchored at the scoped files are returned.  At least one contract
+    anchor (lattice.py / faults.py / serve/protocol.py or a wire
+    surface) must be in scope — raises UnsupportedScope otherwise,
+    because every contract check would be vacuously filtered away.
+    """
+    from . import contracts
     root = repo_root or repo_root_for()
-    return audit(root)
+    if paths is None:
+        return contracts.audit(root)
+    anchors = {contracts._LATTICE_REL, contracts._FAULTS_REL,
+               contracts._PROTOCOL_REL}
+    for _surface, consumer, producer in contracts._SURFACES:
+        anchors.update((consumer, producer))
+    scoped = set(paths)
+    if not scoped & anchors:
+        raise UnsupportedScope(
+            "--contracts with --paths needs at least one contract "
+            "anchor in scope (got none); anchors: "
+            + ", ".join(sorted(anchors)))
+    return [v for v in contracts.audit(root) if v.path in scoped]

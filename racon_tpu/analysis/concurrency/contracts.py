@@ -12,7 +12,10 @@ Three contracts, all extracted statically from the analyzed tree:
   degradation keyword) and a failure-modes docs row (a ``|`` table row
   in ``docs/`` mentioning both tiers).
 * **Fault points** — every name in ``faults.KNOWN_POINTS`` must appear
-  in a test under ``tests/`` and in a docs table row.
+  in a test under ``tests/`` and in a docs table row; the fleet-scoped
+  ones (``worker.*``/``pool.*``/``lease.*``) must additionally be
+  claimed by a protocol-model transition (``fault-model``), so no
+  control-plane injection point escapes the model checker.
 * **Wire protocol** — producers/consumers in ``serve/server.py``,
   ``serve/client.py``, ``distrib/coordinator.py`` and
   ``distrib/worker.py`` are cross-checked field-for-field against the
@@ -31,12 +34,14 @@ import os
 import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from .. import astcache
 from ..lint import Violation, iter_source_files
 
 LATTICE_DRILL = "lattice-drill"
 LATTICE_DOCS = "lattice-docs"
 FAULT_DRILL = "fault-drill"
 FAULT_DOCS = "fault-docs"
+FAULT_MODEL = "fault-model"
 PROTOCOL_RULE = "protocol-mismatch"
 
 _LATTICE_REL = "racon_tpu/resilience/lattice.py"
@@ -68,11 +73,7 @@ def audit(repo_root: str) -> List[Violation]:
 # -- shared helpers ---------------------------------------------------------
 
 def _parse(repo_root: str, rel: str) -> Optional[ast.Module]:
-    try:
-        with open(os.path.join(repo_root, rel)) as f:
-            return ast.parse(f.read(), filename=rel)
-    except (OSError, SyntaxError):
-        return None
+    return astcache.load(repo_root, rel).tree
 
 
 def _test_texts(repo_root: str) -> List[Tuple[str, str]]:
@@ -244,6 +245,29 @@ def _fault_checks(repo_root: str, tests, rows) -> List[Violation]:
                 FAULT_DOCS, _FAULTS_REL, line,
                 f"fault point {point} has no docs table row: no markdown "
                 f"table row under docs/ mentions it"))
+    out.extend(_fault_model_checks(repo_root))
+    return out
+
+
+def _fault_model_checks(repo_root: str) -> List[Violation]:
+    """Every fleet-scoped KNOWN_POINTS entry must be claimed by a
+    protocol-model transition — a fault point the model does not know
+    about is a failure mode no interleaving ever exercises.  Skipped
+    when the tree carries no protocol model (fixture mini-trees)."""
+    from ..protocol import conformance      # local: avoids an import cycle
+    entries, _ = conformance._transitions(repo_root)
+    if entries is None:
+        return []
+    claimed = {e[3] for e in entries if e[3] is not None}
+    out: List[Violation] = []
+    for point, line in fault_points(repo_root):
+        if (point.startswith(conformance.FLEET_PREFIXES)
+                and point not in claimed):
+            out.append(Violation(
+                FAULT_MODEL, _FAULTS_REL, line,
+                f"fleet fault point {point} is not claimed by any "
+                f"protocol-model transition "
+                f"(analysis/protocol/model.py TRANSITIONS)"))
     return out
 
 

@@ -38,6 +38,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
+from .. import astcache
 from ..lint import iter_source_files
 from . import roles as roles_mod
 
@@ -167,13 +168,10 @@ class Model:
         rels = []
         for rel in (paths if paths is not None else
                     iter_source_files(repo_root)):
-            full = os.path.join(repo_root, rel)
-            try:
-                with open(full) as f:
-                    source = f.read()
-                tree = ast.parse(source, filename=rel)
-            except (OSError, SyntaxError):
+            parsed = astcache.load(repo_root, rel)
+            if parsed.tree is None:
                 continue  # run_lint already reports parse errors
+            source, tree = parsed.source, parsed.tree
             m.lines[rel] = source.splitlines()
             m.trees[rel] = tree
             m._mod_by_dotted[_module_dotted(rel)] = rel

@@ -16,6 +16,8 @@ import re
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence
 
+from . import astcache
+
 #: Inline suppression: `# lint: disable=rule-id[,rule-id]` on the
 #: offending line silences those rules for that line only.
 _SUPPRESS_RE = re.compile(r"#\s*lint:\s*disable=([\w,-]+)")
@@ -136,16 +138,12 @@ def run_lint(repo_root: Optional[str] = None,
     contexts: List[FileContext] = []
     violations: List[Violation] = []
     for rel in (paths if paths is not None else iter_source_files(root)):
-        full = os.path.join(root, rel)
-        try:
-            with open(full) as f:
-                source = f.read()
-            tree = ast.parse(source, filename=rel)
-        except (OSError, SyntaxError) as e:
+        parsed = astcache.load(root, rel)
+        if parsed.tree is None:
             violations.append(Violation(
-                "parse-error", rel, getattr(e, "lineno", 0) or 0, str(e)))
+                "parse-error", rel, parsed.error_line, parsed.error or ""))
             continue
-        contexts.append(FileContext(root, rel, source, tree))
+        contexts.append(FileContext(root, rel, parsed.source, parsed.tree))
 
     for ctx in contexts:
         for rule in active:

@@ -1,0 +1,32 @@
+"""Engine 4: the protocol model checker (``--model-check``).
+
+An explicit-state checker over a formal, untimed model of the fleet
+chunk lifecycle (model.py), an invariant library evaluated over every
+reachable state (invariants.py), BFS/DFS exploration with minimal
+counterexample traces (checker.py), a compiler from counterexamples to
+replayable ``RACON_TPU_FAULT`` schedules (replay.py), and a
+model<->implementation conformance pass (conformance.py) that keeps
+the model from drifting away from the code it abstracts.
+
+The conformance pass emits ``lint.Violation`` objects so the existing
+baseline / suppression / CLI plumbing applies unchanged; the state
+exploration has its own entry points below (it is deliberately not
+part of default full-tree runs — exhausting the bounded space costs
+tens of seconds, which the lint path must not pay).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..lint import Violation, repo_root_for
+from .checker import Result, check          # noqa: F401 (re-export)
+from .model import (Config, MUTATIONS, TRANSITIONS,   # noqa: F401
+                    mutation_names)
+
+
+def run_conformance(repo_root: Optional[str] = None) -> List[Violation]:
+    """Run the model<->code conformance pass over one repo tree."""
+    from .conformance import audit
+    root = repo_root or repo_root_for()
+    return audit(root)

@@ -1,0 +1,7 @@
+"""Seeded fixture: one TRANSITIONS entry claims a fault point that is
+not in faults.KNOWN_POINTS -> exactly one `model-fault` finding."""
+
+TRANSITIONS = (
+    ("steal", "racon_tpu/fleet/plane.py", "_fetch", "pool.steal"),
+    ("warp", "racon_tpu/fleet/plane.py", "_fetch", "pool.warp"),
+)

@@ -1,0 +1,9 @@
+"""Fixture code site: `_fetch` exists and its injection point is
+claimed by the model, so only the unknown point fires."""
+
+from racon_tpu.resilience import faults
+
+
+def _fetch(worker):
+    faults.check("pool.steal")
+    return worker

@@ -1,0 +1,9 @@
+"""Fixture fault registry: knows pool.steal, not pool.warp."""
+
+KNOWN_POINTS = frozenset({
+    "pool.steal",
+})
+
+
+def check(point):
+    return point

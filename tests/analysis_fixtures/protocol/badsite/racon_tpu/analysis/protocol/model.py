@@ -1,0 +1,8 @@
+"""Seeded fixture: one TRANSITIONS entry points at a callable the site
+file no longer defines -> exactly one `model-site` finding.  No
+faults.py in this tree, so the fault checks are skipped."""
+
+TRANSITIONS = (
+    ("dispatch", "racon_tpu/fleet/plane.py", "_assign", None),
+    ("vanish", "racon_tpu/fleet/plane.py", "_no_such_handler", None),
+)

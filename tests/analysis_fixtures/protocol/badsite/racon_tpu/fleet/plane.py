@@ -1,0 +1,6 @@
+"""Fixture code site: defines `_assign` (live) but not
+`_no_such_handler` (the model points at dead code)."""
+
+
+def _assign(chunk, worker):
+    return (chunk, worker)

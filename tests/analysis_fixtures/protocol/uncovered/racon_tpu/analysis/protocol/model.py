@@ -1,0 +1,6 @@
+"""Seeded fixture: the tree injects a fleet-scoped fault point the
+model claims nowhere -> exactly one `model-coverage` finding."""
+
+TRANSITIONS = (
+    ("dispatch", "racon_tpu/fleet/plane.py", "_assign", None),
+)

@@ -1,0 +1,13 @@
+"""Fixture code site: a live `faults.check("pool.steal")` injection
+the model's TRANSITIONS never claims."""
+
+from racon_tpu.resilience import faults
+
+
+def _assign(chunk, worker):
+    return (chunk, worker)
+
+
+def _fetch(worker):
+    faults.check("pool.steal")
+    return worker
