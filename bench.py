@@ -335,6 +335,19 @@ def band_stamp(snap):
     return cells or None, round(c.get("band.hits", 0) / jobs, 4)
 
 
+def mem_stamp(report_summary):
+    """``(peak_rss_mb, budget_mb)`` from a RunReport.summary()'s
+    ``memory`` phase (the resilience/budget.py accounting stamp);
+    ``(None, None)`` when the run carried no memory accounting —
+    "not measured", a different claim from a measured 0."""
+    if isinstance(report_summary, dict):
+        m = report_summary.get("memory")
+        ex = m.get("extra") if isinstance(m, dict) else None
+        if isinstance(ex, dict):
+            return ex.get("peak_rss_mb"), ex.get("budget_mb")
+    return None, None
+
+
 def normalize_entry(e: dict) -> dict:
     """Reader-side honesty backfill for bench JSON entries/log lines.
 
@@ -389,6 +402,14 @@ def normalize_entry(e: dict) -> dict:
         # entries written before the elastic pool existed: explicit null
         # ("no pool-size timeline"), same as a run with the fleet off
         e = dict(e, pool=None)
+    if "peak_rss_mb" not in e or "budget_mb" not in e:
+        # entries written before the memory budget existed: recover the
+        # pair from the embedded report's memory phase when the run
+        # stamped one, else explicit nulls ("not measured")
+        peak, bud = mem_stamp(e.get("report"))
+        e = dict(e)
+        e.setdefault("peak_rss_mb", peak)
+        e.setdefault("budget_mb", bud)
     return e
 
 
@@ -414,6 +435,8 @@ def degraded_result(mbps_cpu: float, note: str = "") -> dict:
         "serial_steps": None,
         "cells_banded": None,
         "band_hit_rate": None,
+        "peak_rss_mb": None,
+        "budget_mb": None,
     }
 
 
@@ -590,6 +613,7 @@ def main():
         config.get_str("RACON_TPU_MACHINE_PROFILE") or "auto",
         platform=platform)
     cells_banded, band_hit_rate = band_stamp(snap_tpu)
+    peak_rss_mb, budget_mb = mem_stamp(rep_tpu)
     log_device_measurement({
         "mbp": MBP, "input": INPUT, "profile": PROFILE,
         "value": round(mbps_tpu, 4),
@@ -603,6 +627,7 @@ def main():
         "cost_model": cm,
         "serial_steps": serial_steps_stamp(cm),
         "cells_banded": cells_banded, "band_hit_rate": band_hit_rate,
+        "peak_rss_mb": peak_rss_mb, "budget_mb": budget_mb,
         **({"sanitize": True} if sanitized else {}),
     })
     print(json.dumps({
@@ -616,6 +641,7 @@ def main():
         "cost_model": cm,
         "serial_steps": serial_steps_stamp(cm),
         "cells_banded": cells_banded, "band_hit_rate": band_hit_rate,
+        "peak_rss_mb": peak_rss_mb, "budget_mb": budget_mb,
         **({"sanitize": True} if sanitized else {}),
     }))
     print(f"[bench] tpu: {bp_tpu} bp in {dt_tpu:.1f}s | "
@@ -709,6 +735,8 @@ def serve_profile(jobs: int = 4, clients: int = 2) -> int:
         "serial_steps": None,
         "cells_banded": None,
         "band_hit_rate": None,
+        "peak_rss_mb": None,
+        "budget_mb": None,
         "serve": serve_stats,
         # scraped daemon telemetry (stats-op samples during the run)
         "fleet": summary.get("daemon_stats"),
@@ -796,6 +824,8 @@ def distrib_profile(workers: int = 3) -> int:
         "serial_steps": None,
         "cells_banded": None,
         "band_hit_rate": None,
+        "peak_rss_mb": None,
+        "budget_mb": None,
         "distrib": distrib_stats,
         # fleet telemetry from the coordinator: per-worker chunk/kernel
         # walls, dispatch-queue wait p95, heartbeat staleness max
@@ -821,6 +851,169 @@ def distrib_profile(workers: int = 3) -> int:
           f"replayed {distrib_stats['journal_replayed']}",
           file=sys.stderr)
     return 0 if served_total == result["chunks"] else 1
+
+
+def stream_dataset(mbp: float, contigs: int):
+    """Multi-contig dataset for the streaming bench, cached like
+    dataset() (keyed by size/coverage/contigs + simulator source)."""
+    import hashlib
+    import inspect
+    import shutil
+
+    from racon_tpu.tools import simulate
+
+    src_tag = hashlib.sha256(
+        (inspect.getsource(simulate) +
+         repr(sorted(PROFILES[PROFILE].items()))).encode()).hexdigest()[:12]
+    outdir = (f"/tmp/racon_tpu_bench_stream_{mbp}mbp_{COVERAGE}x_"
+              f"{contigs}c_{src_tag}")
+    if not os.path.isdir(outdir):
+        tmpdir = outdir + f".tmp{os.getpid()}"
+        shutil.rmtree(tmpdir, ignore_errors=True)
+        simulate.generate(tmpdir, mbp=mbp, coverage=COVERAGE,
+                          contigs=contigs, **PROFILES[PROFILE])
+        try:
+            os.rename(tmpdir, outdir)
+        except OSError:
+            shutil.rmtree(tmpdir, ignore_errors=True)  # another run won
+    ovl = "overlaps.sam" if INPUT == "sam" else "overlaps.paf"
+    return {k: os.path.join(outdir, f)
+            for k, f in (("reads", "reads.fastq"),
+                         ("overlaps", ovl),
+                         ("draft", "draft.fasta"))}
+
+
+def stream_profile(contigs: int = 4) -> int:
+    """`python bench.py stream`: the bounded-memory streaming path.
+
+    Polishes a multi-contig draft through a CLI subprocess with the
+    streaming input path armed under RACON_TPU_MEM_BUDGET_MB (default
+    2048 MiB — override the knob for tighter drills), and stamps Mbp/s
+    plus the run's memory accounting: ``peak_rss_mb`` (what the
+    watchdog observed) against ``budget_mb``.  The `profile:
+    stream-<PROFILE>` field keeps it its own trend series for the
+    `obs bench` regression gate.  vs_baseline is null: byte-identity to
+    the in-memory path is CI's cmp gate, not a throughput ratio.
+
+    Genome-scale recipe (what the CI-sized default rehearses)::
+
+        RACON_TPU_BENCH_MBP=3000 RACON_TPU_MEM_BUDGET_MB=8192 \\
+            python bench.py stream
+
+    — a 3 Gbp human-scale draft polished with peak RSS bounded by the
+    chunk working set, not the genome (see docs/benchmarks.md)."""
+    import tempfile
+
+    degraded = not device_healthy()
+    platform = None
+    if not degraded:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, timeout=120, text=True)
+            platform = r.stdout.strip() if r.returncode == 0 else None
+        except subprocess.TimeoutExpired:
+            platform = None
+    budget = config.get_int("RACON_TPU_MEM_BUDGET_MB") or 2048
+    paths = stream_dataset(MBP, contigs)
+    workdir = tempfile.mkdtemp(prefix="racon_tpu_bench_stream.")
+    out_path = os.path.join(workdir, "polished.fasta")
+    report_path = os.path.join(workdir, "report.json")
+    # the streaming bench measures memory behavior, not kernels: off a
+    # real TPU (dead tunnel, cpu backend, dry run) it runs the
+    # small-window host fast path — same reasoning as serve_profile: the
+    # XLA-twin consensus at w=500 runs minutes/window on a CPU backend
+    on_device = platform == "tpu" and not _forced_device()
+    w = ARGS["window_length"] if on_device else 100
+    env = dict(os.environ)
+    env.pop("RACON_TPU_FAULT", None)
+    if not on_device:
+        env.update(JAX_PLATFORMS="cpu", RACON_TPU_PALLAS="0",
+                   RACON_TPU_POA_KERNEL="v2", RACON_TPU_BATCH_WINDOWS="8",
+                   RACON_TPU_DEVICE_ALIGNER="xla")
+    env["RACON_TPU_MEM_BUDGET_MB"] = str(budget)
+    env["RACON_TPU_STREAM_INPUT"] = "1"
+    cmd = [sys.executable, "-m", "racon_tpu.cli", "--tpu",
+           "-w", str(w), "--report", report_path,
+           paths["reads"], paths["overlaps"], paths["draft"]]
+    t0 = time.monotonic()
+    with open(out_path, "w") as out_f, \
+            open(os.path.join(workdir, "stderr.log"), "w") as err_f:
+        rc = subprocess.call(cmd, stdout=out_f, stderr=err_f, env=env)
+    wall = time.monotonic() - t0
+    if rc != 0:
+        tail = ""
+        try:
+            with open(os.path.join(workdir, "stderr.log")) as f:
+                tail = f.read()[-500:]
+        except OSError:
+            pass
+        print(f"[bench] stream: CLI exited {rc}: {tail}", file=sys.stderr)
+        return 1
+    polished_bp = 0
+    with open(out_path) as f:
+        for line in f:
+            if not line.startswith(">"):
+                polished_bp += len(line.strip())
+    value = polished_bp / 1e6 / wall if wall > 0 else 0.0
+    try:
+        with open(report_path) as f:
+            rep = json.load(f).get("phases", {})
+    except (OSError, ValueError):
+        rep = {}
+    peak_rss_mb, budget_mb = mem_stamp(rep)
+    mem = rep.get("memory", {}) if isinstance(rep, dict) else {}
+    extra = mem.get("extra", {}) if isinstance(mem, dict) else {}
+    stream_stats = {
+        "contigs": contigs,
+        "streamed": extra.get("streamed"),
+        "pressure_level": extra.get("pressure_level"),
+        "quarantined": len(mem.get("quarantined", [])
+                           if isinstance(mem, dict) else []),
+        "degradations": sum(len(p.get("degradations", []))
+                            for p in rep.values()
+                            if isinstance(p, dict)),
+    }
+    tag = " [TPU UNREACHABLE: host backend]" if degraded else ""
+    if _forced_device():
+        tag += " [FORCED DRY-RUN: not device evidence]"
+    entry = {
+        "metric": f"stream: polished Mbp/sec ({_WORKLOAD} {MBP} Mbp "
+                  f"{COVERAGE}x, {INPUT.upper()}, w={w}, {contigs} "
+                  f"contigs, budget {budget} MiB, end-to-end){tag}",
+        "value": round(value, 4),
+        "unit": "Mbp/s",
+        # no paired oracle run here — byte-identity is CI's cmp gate;
+        # explicit nulls keep normalize_entry a fixed point
+        "vs_baseline": None,
+        "cost_model": None,
+        "pack_split": None,
+        "serial_steps": None,
+        "cells_banded": None,
+        "band_hit_rate": None,
+        "peak_rss_mb": peak_rss_mb,
+        "budget_mb": budget_mb,
+        "stream": stream_stats,
+        **({"device_status": "unreachable"} if degraded else {}),
+    }
+    assert normalize_entry(dict(entry)) == entry, \
+        "stream bench entry must be a normalize_entry fixed point"
+    log_device_measurement({
+        "mbp": MBP, "input": INPUT, "profile": f"stream-{PROFILE}",
+        "value": round(value, 4), "vs_baseline": None,
+        "kernel": "host" if degraded else
+        (config.get_str("RACON_TPU_POA_KERNEL") or "ls"),
+        "stream": stream_stats,
+        "peak_rss_mb": peak_rss_mb, "budget_mb": budget_mb,
+        "cost_model": None, "pack_split": None, "serial_steps": None,
+        **({"device_status": "unreachable"} if degraded else {}),
+    })
+    print(json.dumps(entry))
+    print(f"[bench] stream: {polished_bp} bp in {wall:.1f}s, peak RSS "
+          f"{peak_rss_mb} MiB / budget {budget_mb} MiB "
+          f"(pressure {stream_stats['pressure_level']})", file=sys.stderr)
+    return 0
 
 
 def multichip_profile(counts=(1, 2, 4, 8), repeats: int = 3) -> int:
@@ -873,6 +1066,8 @@ def multichip_profile(counts=(1, 2, 4, 8), repeats: int = 3) -> int:
         "serial_steps": None,
         "cells_banded": None,
         "band_hit_rate": None,
+        "peak_rss_mb": None,
+        "budget_mb": None,
         "multichip": mc_stats,
         **({"forced": True} if not real else {}),
     }
@@ -940,4 +1135,6 @@ if __name__ == "__main__":
         sys.exit(distrib_profile())
     if len(sys.argv) > 1 and sys.argv[1] == "multichip":
         sys.exit(multichip_profile())
+    if len(sys.argv) > 1 and sys.argv[1] == "stream":
+        sys.exit(stream_profile())
     main()

@@ -33,6 +33,7 @@ THREAD_ROLE_PATTERNS = {
     "fleet-conn": "fleet plane per-worker connection handler",
     "fleet-monitor": "fleet plane autoscaler/lease monitor "
                      "(fleet/plane.py)",
+    "mem-watchdog": "memory-budget RSS sampler (resilience/budget.py)",
     "poa-warm": "pipelined-phases consensus warm thread (polisher.py)",
     "align-worker": "pipelined-phases alignment feeder (polisher.py)",
     "racon-tpu-watchdog-call": "device-call watchdog runner",

@@ -131,6 +131,28 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
     _k("RACON_TPU_SANITIZE_PARITY", "8", "int",
        "sanitize mode: host-recompute and byte-compare every Nth "
        "device-served window (0 disables the parity probe)"),
+    # -- memory-budget knobs (resilience/budget.py) -----------------------
+    _k("RACON_TPU_MEM_BUDGET_MB", "0", "int",
+       "peak-RSS budget in MiB: arms the memory watchdog, enables the "
+       "streaming input path, and drives the soft/hard watermark "
+       "degradations (0 = unbudgeted)"),
+    _k("RACON_TPU_MEM_SOFT_FRAC", "0.8", "float",
+       "soft watermark as a fraction of the memory budget: above it "
+       "backpressure applies (handoff depth shrinks, queued working "
+       "sets spill to disk)"),
+    _k("RACON_TPU_MEM_HARD_FRAC", "0.95", "float",
+       "hard watermark as a fraction of the memory budget: above it the "
+       "pressure lattice edges fire (pipelined->sequential, "
+       "batched->stream-sequential) and the flight recorder dumps"),
+    _k("RACON_TPU_MEM_SPILL_DIR", None, "str",
+       "directory for parked chunk working sets under memory pressure "
+       "(default: a per-run temp directory)"),
+    _k("RACON_TPU_MEM_POLL_MS", "200", "int",
+       "memory watchdog sampling interval in milliseconds"),
+    _k("RACON_TPU_STREAM_INPUT", None, "bool",
+       "stream per-chunk read/overlap working sets instead of handing "
+       "the full files to every chunk pipeline (auto-enabled when a "
+       "memory budget is set; output is byte-identical either way)"),
     # -- observability knobs ----------------------------------------------
     _k("RACON_TPU_TRACE", None, "str",
        "write a Chrome-trace/Perfetto JSON span timeline of every polish "

@@ -385,13 +385,16 @@ class Coordinator:
             self._count("chunks_fleet")
             ws = self.worker_stats.setdefault(
                 int(req["worker"]),
-                {"chunks": 0, "wall_s": 0.0, "kernel_wall_s": 0.0})
+                {"chunks": 0, "wall_s": 0.0, "kernel_wall_s": 0.0,
+                 "rss_mb": 0.0})
             ws["chunks"] += 1
             ws["wall_s"] = round(
                 ws["wall_s"] + float(stats.get("wall_s") or 0.0), 4)
             ws["kernel_wall_s"] = round(
                 ws["kernel_wall_s"]
                 + float(stats.get("kernel_wall_s") or 0.0), 4)
+            ws["rss_mb"] = max(ws.get("rss_mb", 0.0),
+                               float(stats.get("rss_mb") or 0.0))
             obs.event("distrib.chunk_done", chunk=index,
                       worker=int(req["worker"]), attempt=attempt,
                       replayed=replayed)

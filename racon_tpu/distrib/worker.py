@@ -44,7 +44,13 @@ from .common import WireError, rpc
 def _polish_chunk(a: dict) -> dict:
     """Run one assigned chunk; returns the result stats."""
     from ..polisher import create_polisher
+    from ..resilience import budget, faults
 
+    # the memory seam: kill=1 here is a real OOM-style SIGKILL of this
+    # worker mid-chunk (scope with RACON_TPU_DISTRIB_FAULT_WORKER) —
+    # the lease/journal machinery resumes the chunk byte-identically;
+    # a raise is a modeled allocation failure (chunk error, re-queued)
+    faults.check("mem.oom")
     t0 = time.monotonic()
     chunk_dir = os.path.dirname(a["output"]) or "."
     # trace-context propagation: the coordinator's dispatch shipped a
@@ -80,12 +86,18 @@ def _polish_chunk(a: dict) -> dict:
         sum(rep.wall_s.values())
         for name, rep in polisher.report.phases.items()
         if name in ("alignment", "consensus"))
+    # per-worker peak RSS rides back in the stats (the coordinator /
+    # fleet plane track the max per worker into fleet_telemetry()) and
+    # lands as a trace instant for the `obs fleet` per-pid RSS column
+    rss = round(budget.peak_rss_mb(), 1)
+    obs.event("mem.rss", rss_mb=rss, chunk=a["index"])
     return {
         "wall_s": round(time.monotonic() - t0, 4),
         "records": len(out),
         "polished_bp": sum(len(data) for _, data in out),
         "journal_replayed": replayed,
         "kernel_wall_s": round(kernel_wall, 4),
+        "rss_mb": rss,
     }
 
 

@@ -249,6 +249,11 @@ def telemetry_tick(**gauges) -> dict:
     global _telemetry
     entry = {"t_mono_ns": time.monotonic_ns()}
     entry.update(gauges)
+    # every tick carries the process RSS: memory is the gauge that
+    # matters when the budget watchdog (resilience/budget.py) is the
+    # thing a poller wants to see approaching its watermarks
+    from ..resilience import budget as _budget
+    entry["mem.rss_mb"] = round(_budget.rss_mb(), 1)
     m = _metrics
     if m is not None:
         entry["served_total"] = m.prefix_sum("served.")

@@ -432,7 +432,7 @@ def fleet_breakdown(doc: dict) -> dict:
             continue
         p = per.setdefault(pid, {"spans": 0, "events": 0, "chunks": 0,
                                  "dispatches": 0, "chunk_wall_us": 0,
-                                 "kernel_wall_us": 0})
+                                 "kernel_wall_us": 0, "peak_rss_mb": 0.0})
         args = ev.get("args") if isinstance(ev.get("args"), dict) else {}
         name = ev.get("name", "")
         if ev.get("ph") == "X":
@@ -456,6 +456,14 @@ def fleet_breakdown(doc: dict) -> dict:
                     trace_ids.add(args["trace_id"])
             elif name in _ELASTIC_NAMES:
                 elastic[_ELASTIC_NAMES[name]] += 1
+            elif name == "mem.rss":
+                # per-worker peak RSS (distrib/worker.py stamps one
+                # instant per chunk) — the memory column of `obs fleet`
+                try:
+                    p["peak_rss_mb"] = max(p["peak_rss_mb"],
+                                           float(args.get("rss_mb") or 0.0))
+                except (TypeError, ValueError):
+                    pass
     # second pass: parenting — a chunk span's parent must be a dispatch
     for ev in doc.get("traceEvents", []):
         if not (isinstance(ev, dict) and ev.get("ph") == "X"
@@ -502,7 +510,8 @@ def cmd_fleet(args) -> int:
                   f"chunks={p['chunks']:<3d} "
                   f"dispatches={p['dispatches']:<3d} "
                   f"chunk={p['chunk_wall_us'] / 1e3:>9.2f} ms  "
-                  f"kernel={p['kernel_wall_us'] / 1e3:>9.2f} ms")
+                  f"kernel={p['kernel_wall_us'] / 1e3:>9.2f} ms  "
+                  f"peak_rss={p['peak_rss_mb']:>7.1f} MiB")
         if b["trace_ids"]:
             print(f"  trace id: {', '.join(b['trace_ids'])} "
                   f"({b['dispatch_span_ids']} dispatch span ids)")

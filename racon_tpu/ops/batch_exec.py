@@ -79,6 +79,7 @@ from collections import deque
 import numpy as np
 
 from .. import config, obs
+from ..resilience import budget
 from ..resilience import lattice as rl
 
 
@@ -142,9 +143,27 @@ class BatchExecutor:
         self.shard_pad_rows = 0  # rows added padding batches to a
         #                          device multiple (sharded mode only)
 
+    def _check_pressure(self) -> None:
+        """Hard-watermark reaction at the pack seam: every queued packed
+        chunk is host memory, so once the memory budget's hard watermark
+        latches the executor stops queuing — depth drops to 1 and each
+        pack resolves inline (batched -> stream-sequential, recorded
+        once per executor).  Byte-identical: depth only changes when
+        results are waited on, never what computes."""
+        if self.depth <= 1 or not budget.hard_latched():
+            return
+        self.depth = 1
+        if self.report is not None:
+            self.report.record_degrade(
+                "batched", "stream-sequential",
+                RuntimeError("hard memory watermark"))
+        obs.count("mem.depth_collapses")
+        self.flush()
+
     # -- feeding -----------------------------------------------------------
     def submit(self, ctx, idxs) -> None:
         """Export, pack, and dispatch one chunk; drain at depth Q."""
+        self._check_pressure()
         ops = self.ops
         kind = ops.live_tier(ctx, None)
         if kind == "host":

@@ -17,13 +17,14 @@ output.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 from typing import List, Optional, Tuple
 
 from . import config, obs
 from .pipeline import Pipeline
-from .resilience import faults, watchdog
+from .resilience import budget, faults, watchdog
 from .resilience.journal import (Journal, input_fingerprint,
                                  replay_windows)
 from .resilience.report import PhaseReport, RunReport
@@ -113,6 +114,7 @@ def reset_run_state(trace_path: Optional[str]) -> None:
     reason."""
     faults.reset()     # per-run firing schedule (deterministic)
     watchdog.reset()   # per-run wedge streaks
+    budget.configure()  # fresh memory watermarks + RSS watchdog
     from .analysis import sanitize
     sanitize.reset()   # per-run sanitizer findings
     obs.reset()        # per-run trace/metrics (disarmed unless armed
@@ -237,15 +239,34 @@ class TpuPolisher:
                   "ignored — the window journal needs run-global indices; "
                   "running the phases sequentially", file=sys.stderr)
             self._pipelined = False
-        # Pipelined mode parses per target chunk; the full-target
+        # Streaming input (RACON_TPU_STREAM_INPUT=1, auto-armed by a
+        # memory budget): each target chunk's pipeline parses a
+        # byte-range subset of the reads/overlaps files instead of the
+        # whole inputs, so peak RSS is O(chunk) — see streamio.py.
+        # Like pipelining, it chunks the target, so journaled runs
+        # (run-global window indices) stay on the unchunked path.
+        self._stream = (config.get_bool("RACON_TPU_STREAM_INPUT")
+                        or budget.budget_mb() > 0)
+        if self._stream and self._journal is not None:
+            print("[racon_tpu::polisher] NOTE: streaming input ignored — "
+                  "the window journal needs run-global indices; parsing "
+                  "the full inputs", file=sys.stderr)
+            self._stream = False
+        # Chunked modes parse per target chunk; the full-target
         # Pipeline is only built when we end up sequential.
-        self._pipeline = (None if self._pipelined else
+        self._pipeline = (None if (self._pipelined or self._stream) else
                           Pipeline(sequences_path, overlaps_path,
                                    target_path, **kwargs))
         self._queue = None
         self._worker = None
         self._warm = None
         self._tmpdir = None
+        self._chunks = None
+        self._stream_index = None
+        self._collapsed = False
+        # pressure/streaming accounting: torn-chunk quarantines and the
+        # memory lattice edges land here, peak RSS is stamped in extra
+        self._mem_rep = PhaseReport("memory", ())
         self.report = RunReport()
 
     def initialize(self) -> None:
@@ -257,12 +278,19 @@ class TpuPolisher:
                 "run without --tpu for the host path") from e
 
         obs.maybe_start_device_trace()
-        if self._pipelined:
+        if self._pipelined or self._stream:
             chunks = self._split_target()
             if chunks is not None:
-                self._start_phase_pipeline(chunks, run_alignment_phase)
+                self._chunks = chunks
+                if self._stream:
+                    self._arm_streaming(chunks)
+                if self._pipelined:
+                    self._start_phase_pipeline(chunks, run_alignment_phase)
+                # streaming without pipelining defers the per-chunk
+                # polish loop to polish()
                 return
             self._pipelined = False
+            self._stream = False
         if self._pipeline is None:
             self._pipeline = Pipeline(*self._paths, **self._kwargs)
         with obs.span("phase.parse"):
@@ -277,14 +305,15 @@ class TpuPolisher:
 
     # -- phase pipelining --------------------------------------------------
     def _split_target(self):
-        """Chunk the target FASTA for the phase pipeline; None (with a
-        note) when the input is not splittable — sequential fallback."""
+        """Chunk the target FASTA for the phase pipeline / streaming
+        loop; None (with a note) when the input is not splittable —
+        sequential full-input fallback."""
         import tempfile
 
         target = self._paths[2]
         if not target.lower().endswith((".fa", ".fasta",
                                         ".fa.gz", ".fasta.gz")):
-            print("[racon_tpu::polisher] NOTE: phase pipelining needs a "
+            print("[racon_tpu::polisher] NOTE: chunked polishing needs a "
                   "FASTA target; running the phases sequentially",
                   file=sys.stderr)
             return None
@@ -301,6 +330,87 @@ class TpuPolisher:
                   file=sys.stderr)
         return chunks
 
+    # -- streaming working sets -------------------------------------------
+    def _arm_streaming(self, chunks) -> None:
+        """Build the per-chunk byte-range index (one streaming pass over
+        each input).  Unsupported formats (MHAP's ordinal read ids) and
+        unreadable inputs fall back to full-file chunk pipelines with a
+        NOTE — never an error here; the native parser renders the final
+        verdict on the full files."""
+        from .streamio import TORN_ERRORS, StreamIndex, StreamUnsupported
+
+        try:
+            self._stream_index = StreamIndex(
+                self._paths[0], self._paths[1], chunks, self._tmpdir)
+        except StreamUnsupported as e:
+            print(f"[racon_tpu::polisher] NOTE: streaming input disabled "
+                  f"({e}); chunk pipelines parse the full inputs",
+                  file=sys.stderr)
+            self._stream_index = None
+        except TORN_ERRORS as e:
+            print(f"[racon_tpu::polisher] NOTE: streaming index failed "
+                  f"({type(e).__name__}: {e}); chunk pipelines parse the "
+                  f"full inputs", file=sys.stderr)
+            self._stream_index = None
+
+    def _chunk_inputs(self, ci: int):
+        """(sequences, overlaps, subset_paths) for chunk ci's pipeline:
+        the streamed working-set subset when streaming is armed, the
+        full inputs otherwise.  This is the synchronous per-chunk
+        budget poll (the deterministic ``mem.pressure`` seam); under
+        soft-or-worse pressure the working set round-trips through the
+        disk spill file before realization.  A torn chunk is
+        quarantined — recorded in the RunReport, the run continues —
+        and polishes from whatever working set the index recovered
+        before the tear."""
+        level = budget.poll()
+        idx = self._stream_index
+        if idx is None:
+            return self._paths[0], self._paths[1], None
+        torn = idx.torn(ci)
+        try:
+            ws = idx.materialize(ci)
+            if budget.at_least(level, "soft"):
+                ws.park(budget.spill_dir(self._tmpdir))
+            paths = ws.realize(self._tmpdir)
+        except Exception as e:  # noqa: BLE001 — degrade, never die
+            self._quarantine_chunk(ci, torn or e)
+            return self._paths[0], self._paths[1], None
+        if torn is not None:
+            self._quarantine_chunk(ci, torn)
+        return paths[0], paths[1], paths
+
+    def _quarantine_chunk(self, ci: int, exc: BaseException) -> None:
+        print(f"[racon_tpu::polisher] WARNING: chunk {ci} working set "
+              f"degraded ({type(exc).__name__}: {exc}); quarantining the "
+              f"chunk", file=sys.stderr)
+        self._mem_rep.record_quarantine(ci, exc)
+
+    @staticmethod
+    def _release_ws(ws_paths) -> None:
+        """Delete a chunk's realized subset files (the native pipeline
+        has fully parsed them by the end of prepare())."""
+        if ws_paths:
+            for p in ws_paths:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+    def _maybe_collapse(self) -> bool:
+        """Hard-watermark latch for the pipelined path: once crossed,
+        the alignment worker stops running ahead of POA (the phase
+        pipeline collapses to sequential consumption) and the pressure
+        lattice edge is recorded once."""
+        if not budget.hard_latched():
+            return False
+        if not self._collapsed:
+            self._collapsed = True
+            self._mem_rep.record_degrade(
+                "pipelined", "sequential",
+                RuntimeError("hard memory watermark"))
+        return True
+
     def _start_phase_pipeline(self, chunks, run_alignment_phase) -> None:
         """Arm the bounded handoff queue, the kernel prewarm thread (its
         compiles overlap the alignment phase instead of serializing
@@ -313,7 +423,7 @@ class TpuPolisher:
         from .ops import poa_driver
 
         kwargs = self._kwargs
-        seqs, ovls, target = self._paths
+        target = self._paths[2]
 
         def warm():
             try:
@@ -337,9 +447,20 @@ class TpuPolisher:
         def worker():
             try:
                 for ci, chunk_path in enumerate(chunks):
+                    # memory backpressure: under soft-or-worse pressure
+                    # stop running ahead of POA until the consumer
+                    # drains the handoff queue; a hard breach collapses
+                    # the pipeline for the rest of the run
+                    # (pipelined -> sequential, recorded once)
+                    while ((self._maybe_collapse()
+                            or budget.at_least(budget.level(), "soft"))
+                           and not q.empty()):
+                        time.sleep(0.02)
+                    seqs_i, ovls_i, ws_paths = self._chunk_inputs(ci)
                     with obs.span("phase.parse", chunk=ci):
-                        pl = Pipeline(seqs, ovls, chunk_path, **kwargs)
+                        pl = Pipeline(seqs_i, ovls_i, chunk_path, **kwargs)
                         pl.prepare()
+                    self._release_ws(ws_paths)
                     with obs.span("phase.align", chunk=ci) as sp:
                         stats = run_alignment_phase(pl, journal=None)
                         sp.set(device=stats.get("device"),
@@ -406,11 +527,87 @@ class TpuPolisher:
         self.report.attach(cons_rep)
         return out
 
+    def _polish_stream_sequential(self, drop_unpolished: bool):
+        """Streaming without phase pipelining: one chunk at a time —
+        materialize the working set, polish, release — so peak RSS is
+        O(chunk), not O(genome)."""
+        from .ops.align_driver import run_alignment_phase
+        from .ops.poa_driver import run_consensus_phase
+
+        align_rep = None
+        cons_rep = None
+        out: List[Tuple[str, str]] = []
+        try:
+            for ci, chunk_path in enumerate(self._chunks):
+                seqs_i, ovls_i, ws_paths = self._chunk_inputs(ci)
+                with obs.span("phase.parse", chunk=ci):
+                    pl = Pipeline(seqs_i, ovls_i, chunk_path,
+                                  **self._kwargs)
+                    pl.prepare()
+                self._release_ws(ws_paths)
+                with obs.span("phase.align", chunk=ci) as sp:
+                    stats = run_alignment_phase(pl, journal=None)
+                    sp.set(device=stats.get("device"),
+                           host=stats.get("host"))
+                with obs.span("phase.window_assign", chunk=ci):
+                    pl.build_windows()
+                rep = stats.get("report")
+                if rep is not None:
+                    if align_rep is None:
+                        align_rep = rep
+                    else:
+                        align_rep.merge(rep)
+                with obs.span("phase.poa", chunk=ci):
+                    cstats = run_consensus_phase(
+                        pl,
+                        match=self._kwargs.get("match", 3),
+                        mismatch=self._kwargs.get("mismatch", -5),
+                        gap=self._kwargs.get("gap", -4),
+                        trim=self._kwargs.get("trim", True),
+                        journal=None)
+                crep = cstats.get("report")
+                if crep is not None:
+                    if cons_rep is None:
+                        cons_rep = crep
+                    else:
+                        cons_rep.merge(crep)
+                with obs.span("phase.stitch", chunk=ci):
+                    out.extend(pl.stitch(drop_unpolished))
+                del pl   # release the chunk's native working set
+        finally:
+            if self._tmpdir is not None:
+                import shutil
+
+                shutil.rmtree(self._tmpdir, ignore_errors=True)
+                self._tmpdir = None
+        self.report.attach(align_rep)
+        self.report.attach(cons_rep)
+        return out
+
+    def _stamp_memory(self) -> None:
+        """Attach the memory PhaseReport (peak RSS, budget, pressure
+        verdicts) when a budget/streaming was armed or anything was
+        recorded on it."""
+        b = budget.active()
+        armed = (b is not None and b.enabled) or self._stream
+        if not (armed or self._mem_rep.degradations
+                or self._mem_rep.quarantined):
+            return
+        self._mem_rep.extra.update({
+            "peak_rss_mb": round(budget.peak_rss_mb(), 1),
+            "budget_mb": b.budget_mb if b is not None else 0,
+            "streamed": self._stream_index is not None,
+            "pressure_level": b.level() if b is not None else "ok",
+        })
+        self.report.attach(self._mem_rep)
+
     def polish(self, drop_unpolished: bool = True) -> List[Tuple[str, str]]:
         from .ops.poa_driver import run_consensus_phase
 
         if self._pipelined:
             out = self._polish_pipelined(drop_unpolished)
+        elif self._chunks is not None:
+            out = self._polish_stream_sequential(drop_unpolished)
         else:
             with obs.span("phase.poa"):
                 stats = run_consensus_phase(
@@ -425,6 +622,7 @@ class TpuPolisher:
                 out = self._pipeline.stitch(drop_unpolished)
         if self._journal is not None:
             self._journal.close()
+        self._stamp_memory()
         self.report.finalize().write_env()
         obs.maybe_stop_device_trace()
         obs.write_trace()

@@ -1,0 +1,334 @@
+"""Memory budget: RSS watermarks, backpressure, and working-set spill.
+
+Every other robustness organ in this package reacts to *events* —
+faults, hangs, crashes.  Memory is the resource that kills genome-scale
+runs without ever raising: the process just grows until the kernel's
+OOM killer takes it.  This module makes memory a first-class budget:
+
+* ``MemoryBudget`` samples the process RSS (``/proc/self/status``
+  VmRSS, falling back to ``resource.getrusage``) against
+  ``RACON_TPU_MEM_BUDGET_MB`` and classifies it into three levels::
+
+      ok ──▶ soft (RACON_TPU_MEM_SOFT_FRAC × budget) ──▶ hard
+                                      (RACON_TPU_MEM_HARD_FRAC × budget)
+
+* the **soft watermark** is backpressure: the streaming polisher stops
+  reading ahead and parks materialized chunk working sets to a disk
+  spill file (``park_bytes``/``load_spill``) until pressure clears;
+* the **hard watermark** is degradation, not death: it latches, the
+  flight recorder dumps (``mem_hard_watermark``), and the consumers
+  take the pressure lattice edges — the phase pipeline collapses
+  (``pipelined→sequential``, polisher.py) and the batch executor drains
+  every pack inline (``batched→stream-sequential``, ops/batch_exec.py)
+  — both recorded in the RunReport.  Output stays byte-identical: the
+  edges only change scheduling, never results.
+
+A registered-role watchdog thread (``mem-watchdog``) samples in the
+background so pressure is noticed between synchronous polls; the
+synchronous polls (one per chunk / per pack) are the ones that check
+the ``mem.pressure`` fault point, so injected-fault invocation counting
+stays deterministic.  ``mem.spill`` fires before each park (a raise
+aborts that park — the working set simply stays in memory) and
+``mem.oom`` sits in the distrib worker's polish path, where ``kill=1``
+is a real OOM-style SIGKILL that the lease/journal machinery resumes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from .. import config
+from . import faults
+
+#: Pressure levels, ordered.  ``at_least`` compares against this order.
+LEVELS = ("ok", "soft", "hard")
+
+
+def rss_mb() -> float:
+    """Current resident set size in MiB (VmRSS; falls back to the peak
+    counter on platforms without /proc)."""
+    try:
+        with open("/proc/self/status", "rb") as f:
+            for line in f:
+                if line.startswith(b"VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except (OSError, ValueError, IndexError):
+        pass
+    return peak_rss_mb()
+
+
+def peak_rss_mb() -> float:
+    """Peak resident set size of this process in MiB (ru_maxrss is KiB
+    on Linux)."""
+    try:
+        import resource
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    except (ImportError, OSError):
+        return 0.0
+
+
+def at_least(level: str, floor: str) -> bool:
+    """True when `level` is at or above `floor` in the pressure order."""
+    return LEVELS.index(level) >= LEVELS.index(floor)
+
+
+class MemoryBudget:
+    """RSS watermark tracker for one run.
+
+    ``rss_source`` is injectable (tests drive the watermarks with a fake
+    sampler); callbacks fire on upward level *transitions*, outside the
+    internal lock, on whichever thread observed the crossing.
+    """
+
+    def __init__(self, budget_mb: int, *, soft_frac: float = 0.8,
+                 hard_frac: float = 0.95,
+                 rss_source: Optional[Callable[[], float]] = None,
+                 on_soft: Optional[Callable[[], None]] = None,
+                 on_hard: Optional[Callable[[], None]] = None):
+        self.budget_mb = max(0, int(budget_mb))
+        self.soft_mb = self.budget_mb * soft_frac
+        self.hard_mb = self.budget_mb * hard_frac
+        self._rss = rss_source or rss_mb
+        self._lock = threading.Lock()
+        self._level = "ok"
+        self._hard_latched = False
+        self._peak_mb = 0.0
+        self._on_soft: List[Callable[[], None]] = (
+            [on_soft] if on_soft else [])
+        self._on_hard: List[Callable[[], None]] = (
+            [on_hard] if on_hard else [])
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- classification ---------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self.budget_mb > 0
+
+    def on_soft(self, cb: Callable[[], None]) -> None:
+        with self._lock:
+            self._on_soft.append(cb)
+
+    def on_hard(self, cb: Callable[[], None]) -> None:
+        with self._lock:
+            self._on_hard.append(cb)
+
+    def poll(self, *, fault_check: bool = True) -> str:
+        """Sample RSS, classify, and fire watermark callbacks on upward
+        transitions.  Synchronous per-chunk/per-pack polls check the
+        ``mem.pressure`` fault point (a raise is absorbed as a forced
+        hard breach — the deterministic pressure drill); the watchdog
+        thread polls with ``fault_check=False`` so fault invocation
+        counting stays on the deterministic synchronous schedule."""
+        if not self.enabled:
+            return "ok"
+        forced = None
+        if fault_check and faults.active_spec():
+            try:
+                faults.check("mem.pressure")
+            except Exception as e:  # noqa: BLE001 — injected fault =
+                # forced hard breach (the deterministic pressure drill)
+                forced = e
+        cur = float(self._rss())
+        with self._lock:
+            self._peak_mb = max(self._peak_mb, cur)
+            prev = self._level
+            if forced is not None or cur >= self.hard_mb:
+                level = "hard"
+            elif cur >= self.soft_mb:
+                level = "soft"
+            else:
+                level = "ok"
+            self._level = level
+            newly_hard = level == "hard" and not self._hard_latched
+            if newly_hard:
+                self._hard_latched = True
+            soft_cbs = list(self._on_soft)
+            hard_cbs = list(self._on_hard)
+        if level != prev and at_least(level, "soft"):
+            from .. import obs
+            obs.event("mem.pressure", level=level, rss_mb=round(cur, 1),
+                      budget_mb=self.budget_mb,
+                      forced=bool(forced is not None))
+            obs.count(f"mem.{level}_watermark")
+        if newly_hard:
+            from ..obs import flight
+            flight.dump("mem_hard_watermark", rss_mb=round(cur, 1),
+                        budget_mb=self.budget_mb,
+                        forced=bool(forced is not None))
+            for cb in hard_cbs:
+                cb()
+        if level == "soft" and prev == "ok":
+            for cb in soft_cbs:
+                cb()
+        return level
+
+    def level(self) -> str:
+        """Last classified level (no sampling)."""
+        with self._lock:
+            return self._level
+
+    def hard_latched(self) -> bool:
+        """True once the hard watermark has ever been crossed this run."""
+        with self._lock:
+            return self._hard_latched
+
+    def peak_mb(self) -> float:
+        """Highest sampled RSS this run (MiB); 0.0 before the first
+        poll.  ``peak_rss_mb()`` is the kernel's authoritative number —
+        this one is what the watchdog actually observed."""
+        with self._lock:
+            return self._peak_mb
+
+    # -- watchdog ---------------------------------------------------------
+    def start(self, interval_s: Optional[float] = None) -> None:
+        """Start the background sampler (no-op when unbudgeted)."""
+        if not self.enabled or self._thread is not None:
+            return
+        if interval_s is None:
+            interval_s = max(0.01,
+                             config.get_int("RACON_TPU_MEM_POLL_MS") / 1e3)
+        self._stop.clear()
+        t = threading.Thread(target=self._watch, args=(interval_s,),
+                             name="mem-watchdog", daemon=True)
+        self._thread = t
+        t.start()
+
+    def _watch(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            self.poll(fault_check=False)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+
+
+# --------------------------------------------------------------------------
+# process-global budget (one per run, rebuilt by polisher.reset_run_state)
+# --------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_ACTIVE: Optional[MemoryBudget] = None
+
+
+def configure() -> MemoryBudget:
+    """(Re)build the process budget from the environment and start its
+    watchdog.  Called from ``polisher.reset_run_state`` so every run
+    starts with fresh watermark state, like ``faults.reset``."""
+    global _ACTIVE
+    b = MemoryBudget(
+        budget_mb=config.get_int("RACON_TPU_MEM_BUDGET_MB"),
+        soft_frac=config.get_float("RACON_TPU_MEM_SOFT_FRAC"),
+        hard_frac=config.get_float("RACON_TPU_MEM_HARD_FRAC"))
+    with _LOCK:
+        old, _ACTIVE = _ACTIVE, b
+    if old is not None:
+        old.stop()
+    b.start()
+    return b
+
+
+def active() -> Optional[MemoryBudget]:
+    """The current run's budget (None before the first configure)."""
+    with _LOCK:
+        return _ACTIVE
+
+
+def poll(*, fault_check: bool = True) -> str:
+    """Synchronous pressure poll on the active budget ('ok' when
+    unbudgeted)."""
+    b = active()
+    return b.poll(fault_check=fault_check) if b is not None else "ok"
+
+
+def level() -> str:
+    b = active()
+    return b.level() if b is not None else "ok"
+
+
+def hard_latched() -> bool:
+    b = active()
+    return b.hard_latched() if b is not None else False
+
+
+def budget_mb() -> int:
+    b = active()
+    return b.budget_mb if b is not None else 0
+
+
+def reset() -> None:
+    """Stop the watchdog and drop the budget (tests / teardown)."""
+    global _ACTIVE
+    with _LOCK:
+        old, _ACTIVE = _ACTIVE, None
+    if old is not None:
+        old.stop()
+
+
+# --------------------------------------------------------------------------
+# working-set spill
+# --------------------------------------------------------------------------
+
+def spill_dir(fallback: str) -> str:
+    """The directory parked working sets go to:
+    ``RACON_TPU_MEM_SPILL_DIR`` or the caller's per-run fallback."""
+    return config.get_str("RACON_TPU_MEM_SPILL_DIR") or fallback
+
+
+def park_bytes(payloads: List[Tuple[str, bytes]], dir_path: str,
+               tag: str) -> Optional[str]:
+    """Park named byte buffers to one spill file; returns its path, or
+    None when the park was aborted (``mem.spill`` fault or I/O error) —
+    the caller then simply keeps its in-memory buffers.  The format is a
+    JSON header line of ``[name, length]`` pairs followed by the
+    concatenated blobs."""
+    try:
+        faults.check("mem.spill")
+    except Exception:  # noqa: BLE001 — injected fault = aborted park;
+        # the caller keeps its in-memory buffers
+        from .. import obs
+        obs.count("mem.spill_faults")
+        return None
+    path = os.path.join(dir_path, f"spill.{tag}.{os.getpid()}.bin")
+    try:
+        os.makedirs(dir_path, exist_ok=True)
+        header = json.dumps([[name, len(blob)] for name, blob in payloads])
+        with open(path, "wb") as f:
+            f.write(header.encode() + b"\n")
+            for _name, blob in payloads:
+                f.write(blob)
+    except OSError:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+    from .. import obs
+    obs.event("mem.spill", tag=tag,
+              bytes=sum(len(b) for _n, b in payloads))
+    obs.count("mem.spills")
+    return path
+
+
+def load_spill(path: str) -> List[Tuple[str, bytes]]:
+    """Load parked buffers back and delete the spill file.  Raises
+    OSError/ValueError on a torn spill file — the caller treats that
+    like any other torn-input chunk."""
+    with open(path, "rb") as f:
+        header = json.loads(f.readline().decode())
+        out = []
+        for name, length in header:
+            blob = f.read(int(length))
+            if len(blob) != int(length):
+                raise ValueError(f"torn spill file {path!r}: "
+                                 f"{name} expected {length} bytes, "
+                                 f"got {len(blob)}")
+            out.append((str(name), blob))
+    os.unlink(path)
+    return out

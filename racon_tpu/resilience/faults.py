@@ -110,6 +110,21 @@ KNOWN_POINTS = frozenset({
     "pool.steal",        # fleet plane, before a cross-job work steal
     "lease.reclaim",     # lease layer, before reclaiming a dead
                          # holder's leases
+    # memory-budget seams (racon_tpu/resilience/budget.py): the budget
+    # checks mem.pressure on every synchronous poll — a raise there is
+    # absorbed as a forced hard-watermark breach (the deterministic
+    # memory-pressure drill: backpressure, spill, and the pressure
+    # lattice edges all fire without needing real RSS growth).
+    # mem.spill fires before a chunk working set is parked to the spill
+    # file — a raise aborts that park and the working set stays in
+    # memory (absorbed + counted).  mem.oom fires in the distrib worker
+    # before polishing a fetched chunk; kill=1 there is a real
+    # OOM-style SIGKILL of that worker mid-chunk (scope with
+    # RACON_TPU_DISTRIB_FAULT_WORKER) — the journal/lease machinery
+    # resumes the chunk byte-identically.
+    "mem.pressure",      # budget poll: forced hard-watermark breach
+    "mem.spill",         # before parking a working set to the spill file
+    "mem.oom",           # distrib worker, before polishing a chunk
 })
 
 

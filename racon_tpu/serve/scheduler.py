@@ -23,7 +23,17 @@ host lane itself is saturated the submit is rejected.  The ladder is
 always shed → host lane → reject, in that order.  The estimate is file
 I/O and runs outside the scheduler lock; the check-and-reserve against
 the aggregate happens atomically under it, so concurrent submits cannot
-both squeeze into the same budget headroom.  Fairness is per-submitter
+both squeeze into the same budget headroom.
+
+The ladder also has a **memory dimension** (resilience/budget.py): when
+``RACON_TPU_MEM_BUDGET_MB`` is set, every submit samples the worst of
+the daemon's own RSS and the per-worker RSS the fleet telemetry last
+reported.  A soft watermark sheds the job to the host lane
+(``shed_memory`` — a subprocess's allocations die with it, unlike the
+resident device lane's), a hard watermark rejects outright
+(``rejected_memory``): admitting more work under hard pressure makes
+every lane worse.  Like the window estimate, the sample runs outside
+the scheduler lock (it reads /proc and takes the plane's lock).  Fairness is per-submitter
 round-robin with priority lanes (fleet/queues.py): each submitter has
 its own FIFOs; the scheduler serves the highest priority present and
 rotates submitters within it, so one flooding client cannot starve the
@@ -64,6 +74,7 @@ from typing import Dict, List, Optional
 
 from .. import obs
 from ..fleet import fleet_tenant_quota
+from ..resilience import budget as membudget
 from ..fleet.queues import TenantQueues
 from .session import (JobCancelled, JobSpec, PolishSession, serve_max_jobs,
                       serve_queue_depth, serve_window_budget)
@@ -168,6 +179,9 @@ class Scheduler:
         self._stop = False
         self._counter = 0
         self._workers: List[threading.Thread] = []
+        # injectable for tests: () -> "ok"|"soft"|"hard" — the memory
+        # dimension of the admission ladder (sampled OUTSIDE _cv)
+        self.memory_source = self._memory_pressure
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -268,6 +282,10 @@ class Scheduler:
         # check-and-reserve atomically under it — two concurrent submits
         # can never both fit into the same budget headroom
         est = self._estimate(spec)
+        # so is the memory sample: it reads /proc and (with a plane)
+        # takes the plane's lock — the two condition variables must
+        # never nest
+        mem = self.memory_source()
         with self._cv:
             if self._stop:
                 raise AdmissionError("daemon is shutting down")
@@ -308,7 +326,7 @@ class Scheduler:
                         break
                 spec.job_id = job_id
             job = Job(spec, job_id)
-            lane = self._admission_lane(job, est)
+            lane = self._admission_lane(job, est, mem)
             self._jobs[job_id] = job
             self._enqueue(lane, job)
             self._persist_spec(job)
@@ -328,15 +346,48 @@ class Scheduler:
         w = spec.polish_args()["window_length"]
         return estimate_windows(spec.target, w)
 
+    def _memory_pressure(self) -> str:
+        """Memory-pressure level for admission: the worst of the
+        daemon's own RSS (resilience/budget.py watermarks) and the
+        per-worker RSS the fleet telemetry last reported.  "ok" when
+        unbudgeted.  Lock-free relative to _cv by design — it samples
+        /proc and takes the plane's lock."""
+        b = membudget.active()
+        if b is None or not b.enabled:
+            return "ok"
+        level = b.poll(fault_check=False)
+        if self.plane is not None and not membudget.at_least(level, "hard"):
+            tel = self.plane.fleet_telemetry()
+            worst = max((float(s.get("rss_mb") or 0.0)
+                         for s in tel.get("workers", {}).values()),
+                        default=0.0)
+            if worst >= b.hard_mb:
+                level = "hard"
+            elif worst >= b.soft_mb and not membudget.at_least(level,
+                                                               "soft"):
+                level = "soft"
+        return level
+
     def _admission_count(self, name: str, n: int = 1) -> None:
         # call with self._cv held
         self.admission[name] = self.admission.get(name, 0) + n
 
-    def _admission_lane(self, job: Job, est: Optional[int]) -> str:
+    def _admission_lane(self, job: Job, est: Optional[int],
+                        mem: str = "ok") -> str:
         """Lane decision + window reservation (call with _cv held).
         The ladder: per-job budget demote, then aggregate shed, then —
-        if the host lane cannot absorb the fallout either — reject."""
+        if the host lane cannot absorb the fallout either — reject.
+        ``mem`` is the pre-sampled memory-pressure level: soft sheds to
+        the host lane, hard rejects outright."""
         spec = job.spec
+        if membudget.at_least(mem, "hard"):
+            # the memory dimension's bottom rung: under a hard
+            # watermark admitting anything degrades every lane
+            self._admission_count("rejected_memory")
+            raise AdmissionError(
+                f"memory pressure: RSS at the hard watermark "
+                f"(RACON_TPU_MEM_BUDGET_MB={membudget.budget_mb()}) — "
+                f"resubmit later")
         if not self.host_lane:
             return "device"
         if ((spec.backend or self.session.backend) == "cpu"
@@ -348,7 +399,14 @@ class Scheduler:
             return "host"
         budget = spec.window_budget or self.window_budget
         to_host: Optional[str] = None
-        if budget > 0 and est is not None:
+        if membudget.at_least(mem, "soft"):
+            # memory shed: the host-lane subprocess's allocations die
+            # with it; the resident device lane's do not
+            to_host = (f"shed (memory): RSS over the soft watermark "
+                       f"(RACON_TPU_MEM_BUDGET_MB="
+                       f"{membudget.budget_mb()})")
+            self._admission_count("shed_memory")
+        elif budget > 0 and est is not None:
             if est > budget:
                 to_host = (f"window budget: ~{est} windows > "
                            f"budget {budget}")

@@ -84,6 +84,15 @@ def _clear_kernel_cache():
         reset_partitioner()
     except Exception:  # noqa: BLE001
         pass
+    try:
+        # stop the mem-watchdog and drop latched watermark state so a
+        # test that armed a tight RACON_TPU_MEM_BUDGET_MB cannot leave
+        # hard-latched pressure (or a sampler thread) for the next test
+        from racon_tpu.resilience import budget
+
+        budget.reset()
+    except Exception:  # noqa: BLE001
+        pass
 
 
 _COMP = bytes.maketrans(b"ACGT", b"TGCA")

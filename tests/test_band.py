@@ -448,5 +448,6 @@ def test_bench_band_stamp_and_normalize_entry():
     old = bench.normalize_entry({"value": 1.0})
     assert old["cells_banded"] is None and old["band_hit_rate"] is None
     fresh = {"value": 1.0, "cells_banded": {"align": 5}, "band_hit_rate": 0.1,
-             "cost_model": None, "pack_split": None, "serial_steps": None}
+             "cost_model": None, "pack_split": None, "serial_steps": None,
+             "peak_rss_mb": None, "budget_mb": None}
     assert bench.normalize_entry(dict(fresh)) == fresh

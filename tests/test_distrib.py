@@ -298,6 +298,7 @@ def test_bench_distrib_entry_normalizes_as_fixed_point():
         "value": 2.34, "unit": "Mbp/s", "vs_baseline": None,
         "cost_model": None, "pack_split": None, "serial_steps": None,
         "cells_banded": None, "band_hit_rate": None,
+        "peak_rss_mb": None, "budget_mb": None,
         "distrib": {"workers": 3, "chunks": 6,
                     "served": {"fleet": 6, "local": 0},
                     "redispatches": 1, "journal_replayed": 2},

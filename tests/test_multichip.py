@@ -74,6 +74,7 @@ def test_bench_multichip_entry_normalizes_as_fixed_point():
         "value": 105.2, "unit": "windows/s", "vs_baseline": None,
         "cost_model": None, "pack_split": None, "serial_steps": None,
         "cells_banded": None, "band_hit_rate": None,
+        "peak_rss_mb": None, "budget_mb": None,
         "multichip": {"counts": {"1": {"windows_per_s": 95.1, "ok": True},
                                  "8": {"windows_per_s": 105.2, "ok": True}},
                       "scaling_vs_1": 1.106},

@@ -578,6 +578,7 @@ def test_bench_serve_entry_normalizes_as_fixed_point():
         "value": 1.23, "unit": "Mbp/s", "vs_baseline": None,
         "cost_model": None, "pack_split": None, "serial_steps": None,
         "cells_banded": None, "band_hit_rate": None,
+        "peak_rss_mb": None, "budget_mb": None,
         "serve": {"jobs": 4, "clients": 2,
                   "latency_s": {"p50": 1, "p95": 2, "p99": 3}},
         "fleet": {"samples": 3, "max_queued": 2, "last": None},
