@@ -1,6 +1,9 @@
-"""First-party static analysis: TPU-invariant lint + jaxpr audit.
+"""First-party static analysis: TPU-invariant lint + jaxpr audit +
+concurrency/contract audits + protocol model checker + determinism
+taint auditor.
 
-Two engines, one CLI (``python -m racon_tpu.analysis``):
+Five engines, one CLI (``python -m racon_tpu.analysis``) and one shared
+parsed-AST cache (`astcache.py`).  The two founding engines:
 
 * **AST lint** (`lint.py` + `rules/`): repo-specific rules over the
   Python sources — invariants that every round-5 advisor finding turned
@@ -16,6 +19,13 @@ Two engines, one CLI (``python -m racon_tpu.analysis``):
   forbidden primitives (host callbacks, infeed/outfeed, float64) and
   recompile blow-ups (distinct jit signatures across the grid vs. the
   budgets declared in `ops/poa_driver.py` / `ops/align.py`).
+
+The later engines live in their own subpackages: `concurrency/` (lock
+discipline + contract cross-checks, ``--concurrency``/``--contracts``),
+`protocol/` (explicit-state fleet-lifecycle model checker,
+``--model-check``), and `determinism/` (knob-to-install-seam taint
+audit of the byte-identity contract vs the fingerprint registry,
+``--determinism``, on by default for full-tree runs).
 
 Suppression: append ``# lint: disable=<rule-id>`` to the flagged line,
 or record existing debt in a baseline file (``--write-baseline``) — the

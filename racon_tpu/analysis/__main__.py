@@ -138,8 +138,9 @@ def main(argv=None) -> int:
                         "of the whole tree (CI uses this to focus on the "
                         "modules a change touched); jaxpr audit is "
                         "skipped when --paths is given.  Default is "
-                        "lint-only; an explicit --concurrency/--contracts "
-                        "runs that audit scoped to the paths")
+                        "lint-only; an explicit --concurrency/"
+                        "--contracts/--determinism runs that audit "
+                        "scoped to the paths")
     p.add_argument("--no-jaxpr", action="store_true",
                    help="skip the jaxpr audit (AST lint only; fast)")
     p.add_argument("--no-lint", action="store_true",
@@ -153,6 +154,27 @@ def main(argv=None) -> int:
                         "drills/docs, fault-point drills/docs, wire-"
                         "protocol field agreement); may be combined "
                         "with --concurrency")
+    p.add_argument("--determinism", action="store_true",
+                   help="run only the determinism taint audit "
+                        "(determinism-leak / fingerprint-gap / "
+                        "fingerprint-overkey): prove no cost-only knob "
+                        "value can reach the consensus/CIGAR install "
+                        "seams and that every fingerprint composition "
+                        "covers the output-affecting domain; may be "
+                        "combined with --concurrency/--contracts")
+    p.add_argument("--emit-manifest", default=None, metavar="FILE",
+                   help="with the determinism audit: write the "
+                        "knob/fingerprint classification manifest "
+                        "(determinism.json schema) to FILE ('-' = "
+                        "stdout); implies --determinism")
+    p.add_argument("--det-mutate", default=None, metavar="N|NAME",
+                   help="determinism self-test: seed one contract bug "
+                        "into a scratch copy of the tree (see "
+                        "--list-det-mutations) and audit it; exit goes "
+                        "non-zero when the expected rule catches it")
+    p.add_argument("--list-det-mutations", action="store_true",
+                   help="print every seeded determinism mutant + the "
+                        "rule expected to catch it, and exit")
     p.add_argument("--model-check", action="store_true",
                    help="run the protocol model checker: exhaust the "
                         "bounded fleet-lifecycle state space, evaluate "
@@ -253,6 +275,15 @@ def main(argv=None) -> int:
             ("protocol-invariant",
              "no bounded interleaving of the fleet lifecycle may "
              "violate the invariant library (--model-check)"),
+            ("determinism-leak",
+             "no cost-only knob's value may flow into the "
+             "consensus/CIGAR install seams"),
+            ("fingerprint-gap",
+             "every complete fingerprint composition must cover the "
+             "whole output-affecting domain"),
+            ("fingerprint-overkey",
+             "warning: fingerprint components keyed only on cost-only, "
+             "taint-clean knobs cause needless misses"),
         ):
             print(f"{rid:18s} {doc}")
         return 0
@@ -265,9 +296,32 @@ def main(argv=None) -> int:
                   f"     {doc}")
         return 0
 
+    if args.list_det_mutations:
+        from .determinism import MUTANTS
+        for i, (name, doc, expected, _patches) in enumerate(MUTANTS):
+            print(f"{i}: {name:28s} -> {expected}\n"
+                  f"     {doc}")
+        return 0
+
     root = args.repo_root or lint.repo_root_for()
+
+    if args.det_mutate is not None:
+        from .determinism import run_mutant
+        try:
+            mutant, det, caught = run_mutant(root, args.det_mutate)
+        except (ValueError, RuntimeError) as e:
+            print(f"[analysis] {e}", file=sys.stderr)
+            return 2
+        for v in det.violations + det.warnings:
+            print(v.render())
+        verdict = "CAUGHT" if caught else "MISSED"
+        print(f"[analysis] determinism mutant {mutant[0]}: {verdict} "
+              f"(expected rule: {mutant[2]})")
+        return 1 if caught else 0
     model_check = args.model_check or args.mutate is not None
-    audits_selected = args.concurrency or args.contracts or model_check
+    determinism = args.determinism or args.emit_manifest is not None
+    audits_selected = (args.concurrency or args.contracts or model_check
+                       or determinism)
     violations: List[lint.Violation] = []
     if not audits_selected:
         if not args.no_lint:
@@ -290,6 +344,18 @@ def main(argv=None) -> int:
     except UnsupportedScope as e:
         print(f"[analysis] {e}", file=sys.stderr)
         return 2
+    det_audit = None
+    if determinism or full_default:
+        from .determinism import build_audit
+        det_audit = build_audit(root, paths=args.paths)
+        violations.extend(det_audit.violations)
+        if args.emit_manifest:
+            text = json.dumps(det_audit.manifest, indent=2) + "\n"
+            if args.emit_manifest == "-":
+                sys.stdout.write(text)
+            else:
+                with open(args.emit_manifest, "w") as f:
+                    f.write(text)
     mc_result = None
     if model_check or full_default:
         from .protocol import run_conformance
@@ -317,6 +383,9 @@ def main(argv=None) -> int:
             "new": [vars(v) for v in new],
             "astcache": astcache.stats(),
         }
+        if det_audit is not None:
+            payload["determinism_warnings"] = [
+                vars(v) for v in det_audit.warnings]
         if mc_result is not None:
             payload["model_check"] = {
                 "config": mc_result.config.describe(),
@@ -331,6 +400,9 @@ def main(argv=None) -> int:
     else:
         for v in new:
             print(v.render())
+        if det_audit is not None:
+            for v in det_audit.warnings:
+                print(f"[warn] {v.render()}")
         n_base = len(violations) - len(new)
         tail = f" ({n_base} baselined)" if n_base else ""
         if mc_result is not None:

@@ -6,12 +6,17 @@ contracts, and now the protocol conformance pass).  This module gives
 them one process-wide cache: the first engine to ask for a file pays
 the ``ast.parse``, the rest get the same tree back.
 
-Entries are validated against ``(mtime_ns, size)`` on every lookup, so
-a long-lived process (the test suite, a REPL) that rewrites a fixture
-between runs never sees a stale tree; within one CLI run the stat is
-the only cost.  Failures are cached too — a file that does not parse
-returns the same ``error`` to every engine instead of being re-opened
-per engine.
+Entries are validated against ``(mtime_ns, size, ctime_ns, inode)`` on
+every lookup, so a long-lived process (the test suite, a REPL) that
+rewrites a fixture between runs never sees a stale tree; within one CLI
+run the stat is the only cost.  Size alone is not enough (a same-length
+rewrite keeps it), and neither is mtime (``os.utime`` — or a filesystem
+with coarse timestamps — can produce an mtime-equal rewrite): ctime
+changes on *every* write and cannot be set from userspace, and the
+inode catches atomic replace-by-rename, so a stale parse cannot be
+served to any engine.  Failures are cached too — a file that does not
+parse returns the same ``error`` to every engine instead of being
+re-opened per engine.
 """
 
 from __future__ import annotations
@@ -33,7 +38,7 @@ class Parsed:
     error_line: int = 0             # SyntaxError line (0 when unknown)
 
 
-_cache: Dict[str, Tuple[Tuple[int, int], Parsed]] = {}
+_cache: Dict[str, Tuple[Tuple[int, int, int, int], Parsed]] = {}
 _stats = {"parses": 0, "hits": 0, "failures": 0}
 
 
@@ -42,7 +47,7 @@ def load(repo_root: str, relpath: str) -> Parsed:
     full = os.path.join(repo_root, relpath)
     try:
         st = os.stat(full)
-        key = (st.st_mtime_ns, st.st_size)
+        key = (st.st_mtime_ns, st.st_size, st.st_ctime_ns, st.st_ino)
     except OSError as e:
         _stats["failures"] += 1
         return Parsed(relpath, "", None, str(e))

@@ -1,0 +1,98 @@
+"""Knob-registry extraction + read-site discovery for Engine 5.
+
+The registry side parses the audited tree's ``racon_tpu/config.py``
+*literally* (every ``_k(...)`` call), so the engine audits what the
+file declares, not what an imported module computed — fixture
+mini-trees carry their own tiny config.py the same way the protocol
+conformance pass carries its own TRANSITIONS.
+
+The read side finds every ``config.get_*("RACON_TPU_X") / is_set``
+call in the model's files; the env-registry lint rule already forces
+every knob read through those accessors, so this enumeration is
+complete by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .. import astcache
+
+CONFIG_REL = "racon_tpu/config.py"
+
+#: The sanctioned accessor names (config.py's public readers).
+GETTERS = frozenset({
+    "get_raw", "get_str", "get_int", "get_float", "get_bool", "is_set",
+})
+
+
+@dataclass
+class KnobDecl:
+    """One registered knob, as declared (not imported) in config.py."""
+
+    name: str
+    kind: str = "str"
+    scope: str = "runtime"
+    affects_output: bool = False
+    line: int = 0
+    reads: List["KnobRead"] = field(default_factory=list)
+
+
+@dataclass(frozen=True)
+class KnobRead:
+    """One ``config.get_*("KNOB")`` call site."""
+
+    knob: str
+    relpath: str
+    line: int
+    func: str          # qname of the enclosing model function
+    waived: Optional[str]   # `# determinism: <reason>` text, if any
+
+
+def extract_registry(repo_root: str) -> Optional[Dict[str, KnobDecl]]:
+    """The ``_k(...)`` declarations of ``<root>/racon_tpu/config.py``,
+    or None when the tree has no registry (knob rules are skipped)."""
+    parsed = astcache.load(repo_root, CONFIG_REL)
+    if parsed.tree is None:
+        return None
+    out: Dict[str, KnobDecl] = {}
+    for node in ast.walk(parsed.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "_k"):
+            continue
+        lits: List[object] = []
+        for a in node.args:
+            lits.append(a.value if isinstance(a, ast.Constant) else None)
+        if not lits or not isinstance(lits[0], str):
+            continue
+        kw = {k.arg: k.value.value for k in node.keywords
+              if k.arg and isinstance(k.value, ast.Constant)}
+        decl = KnobDecl(
+            name=lits[0],
+            kind=str(lits[2]) if len(lits) > 2 and lits[2] else "str",
+            scope=str(kw.get("scope",
+                             lits[4] if len(lits) > 4 and lits[4]
+                             else "runtime")),
+            affects_output=bool(kw.get("affects_output", False)),
+            line=node.lineno)
+        out[decl.name] = decl
+    return out
+
+
+def knob_of_call(model, rel: str, node: ast.Call) -> Optional[str]:
+    """The literal knob name a call reads, or None when the call is not
+    a registry accessor.  Resolution goes through the model's namespace
+    so both ``config.get_int(...)`` and an imported alias match."""
+    dotted = model.dotted_in_ns(rel, node.func)
+    if not dotted:
+        return None
+    head, _, attr = dotted.rpartition(".")
+    if attr not in GETTERS or not head.endswith("config"):
+        return None
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None

@@ -1,0 +1,82 @@
+"""The machine-readable ``determinism.json`` manifest.
+
+One document per audit: every knob with its declared/analyzed
+output-affecting verdict and every fingerprint site with its component
+set and expanded coverage.  Downstream consumers (the planned
+device-kernel result cache of ROADMAP open item 5, CI artifacts,
+humans debugging a fingerprint miss) read this instead of re-deriving
+the contract from the source.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from . import fingerprints, knobs, rules, taint
+
+VERSION = 1
+
+
+def build(state: taint.State,
+          decls: Dict[str, knobs.KnobDecl],
+          fp_reg: Optional[fingerprints.Registry],
+          violations: List) -> dict:
+    knob_entries: Dict[str, dict] = {}
+    names = set(decls) | {r.knob for r in state.reads.values()}
+    for name in sorted(names):
+        decl = decls.get(name)
+        reads = sorted((r for r in state.reads.values()
+                        if r.knob == name),
+                       key=lambda r: (r.relpath, r.line))
+        flows = sorted((h for h in state.hits.values()
+                        if h.knob == name),
+                       key=lambda h: (h.relpath, h.line))
+        declared = bool(decl.affects_output) if decl else False
+        leaks = [h for h in flows if h.waived is None]
+        knob_entries[name] = {
+            "registered": decl is not None,
+            "kind": decl.kind if decl else None,
+            "scope": decl.scope if decl else None,
+            "declared_affects_output": declared,
+            "affects_output": declared or bool(leaks),
+            "verdict": ("output-affecting" if declared or leaks
+                        else "cost-only"),
+            "reads": [{"path": r.relpath, "line": r.line,
+                       "func": r.func,
+                       **({"waived": r.waived} if r.waived else {})}
+                      for r in reads],
+            "sink_flows": [{"path": h.relpath, "line": h.line,
+                            "seam": h.seam, "func": h.func,
+                            **({"waived": h.waived} if h.waived
+                               else {})}
+                           for h in flows],
+        }
+
+    site_entries: Dict[str, dict] = {}
+    if fp_reg is not None:
+        for name in sorted(fp_reg.sites):
+            site = fp_reg.sites[name]
+            site_entries[name] = {
+                "helper": site.helper,
+                "complete": site.complete,
+                "line": site.line,
+                "components": {c: list(site.components[c])
+                               for c in sorted(site.components)},
+                "expanded_coverage":
+                    sorted(fp_reg.expanded_coverage(name)),
+            }
+
+    errors = [v for v in violations if v.rule not in rules.WARNING_RULES]
+    warnings = [v for v in violations if v.rule in rules.WARNING_RULES]
+    return {
+        "version": VERSION,
+        "engine": "racon_tpu.analysis.determinism",
+        "taint_iterations": state.iterations,
+        "required_domain": sorted(rules.required_domain(fp_reg, decls)),
+        "knobs": knob_entries,
+        "sites": site_entries,
+        "violations": {
+            "errors": [vars(v) for v in errors],
+            "warnings": [vars(v) for v in warnings],
+        },
+    }

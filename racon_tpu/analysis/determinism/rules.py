@@ -1,0 +1,118 @@
+"""Engine 5 rule evaluation.
+
+Three rules over the taint state plus the two literal registries
+(config.py knob declarations, fingerprint.py site compositions):
+
+* ``determinism-leak`` — a knob declared cost-only (or not declared at
+  all) whose value reaches an install-seam payload.  Anchored at the
+  sink call.
+* ``fingerprint-gap`` — a fingerprint site declared ``complete`` whose
+  expanded composition misses a token from the required domain (every
+  ``OUTPUT_SOURCES`` entry plus ``knob:<NAME>`` for every runtime knob
+  declared ``affects_output=True``).  Anchored at the site's line in
+  fingerprint.py.
+* ``fingerprint-overkey`` (warning) — a site component whose sources
+  are all cost-only, taint-clean knobs: equal-output runs would get
+  needless fingerprint misses.  Anchored at the component line.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..lint import Violation
+from . import fingerprints, knobs, taint
+
+#: Rules that report but never fail the run (or CI).
+WARNING_RULES = frozenset({"fingerprint-overkey"})
+
+
+def required_domain(fp_reg: Optional[fingerprints.Registry],
+                    decls: Dict[str, knobs.KnobDecl]) -> Set[str]:
+    """Every token a complete fingerprint composition must cover."""
+    domain: Set[str] = set(fp_reg.output_sources) if fp_reg else set()
+    for d in decls.values():
+        if d.affects_output and d.scope == "runtime":
+            domain.add(f"knob:{d.name}")
+    return domain
+
+
+def leak_violations(state: taint.State,
+                    decls: Dict[str, knobs.KnobDecl]) -> List[Violation]:
+    out = []
+    for hit in state.hits.values():
+        if hit.waived is not None:
+            continue
+        decl = decls.get(hit.knob)
+        if decl is not None and decl.affects_output:
+            continue                    # declared output-affecting: fine
+        status = ("declared cost-only" if decl is not None
+                  else "not in the config registry")
+        out.append(Violation(
+            "determinism-leak", hit.relpath, hit.line,
+            f"knob {hit.knob} ({status}) flows into the "
+            f"{hit.seam} payload in {hit.func}: output bytes may "
+            f"depend on it; declare affects_output=True and add "
+            f"knob:{hit.knob} to the fingerprint domain, or cut the "
+            f"flow (`# determinism: <reason>` if intentional)"))
+    return out
+
+
+def gap_violations(fp_reg: Optional[fingerprints.Registry],
+                   decls: Dict[str, knobs.KnobDecl]) -> List[Violation]:
+    if fp_reg is None:
+        return []
+    domain = required_domain(fp_reg, decls)
+    out = []
+    for name in sorted(fp_reg.sites):
+        site = fp_reg.sites[name]
+        if not site.complete:
+            continue
+        covered = fp_reg.expanded_coverage(name)
+        for token in sorted(domain - covered):
+            out.append(Violation(
+                "fingerprint-gap", fp_reg.relpath, site.line,
+                f"site `{name}` is declared complete but its "
+                f"composition misses required token `{token}`: two "
+                f"runs differing on it would collide to one "
+                f"fingerprint"))
+    return out
+
+
+def overkey_violations(fp_reg: Optional[fingerprints.Registry],
+                       decls: Dict[str, knobs.KnobDecl],
+                       state: taint.State) -> List[Violation]:
+    if fp_reg is None:
+        return []
+    flowed = {hit.knob for hit in state.hits.values()}
+    out = []
+    for name in sorted(fp_reg.sites):
+        site = fp_reg.sites[name]
+        for comp in sorted(site.components):
+            sources = site.components[comp]
+            knob_names = [t[5:] for t in sources
+                          if t.startswith("knob:")]
+            if not sources or len(knob_names) != len(sources):
+                continue                # any non-knob token earns its keep
+            if any(k in flowed
+                   or decls.get(k) is None
+                   or decls[k].affects_output
+                   for k in knob_names):
+                continue
+            out.append(Violation(
+                "fingerprint-overkey", fp_reg.relpath,
+                site.component_lines.get(comp, site.line),
+                f"site `{name}` component `{comp}` keys only on "
+                f"cost-only, taint-clean knob(s) "
+                f"{', '.join(sorted(knob_names))}: equal-output runs "
+                f"get needless fingerprint misses"))
+    return out
+
+
+def evaluate(state: taint.State,
+             decls: Dict[str, knobs.KnobDecl],
+             fp_reg: Optional[fingerprints.Registry]) -> List[Violation]:
+    """Every Engine 5 violation (warnings included) of one audit."""
+    return (leak_violations(state, decls)
+            + gap_violations(fp_reg, decls)
+            + overkey_violations(fp_reg, decls, state))

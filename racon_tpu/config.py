@@ -37,12 +37,22 @@ class Knob:
     kind: str          # 'str' | 'int' | 'float' | 'bool' — documentation
     doc: str           # one-line effect description (README table text)
     scope: str = "runtime"   # 'runtime' | 'tools' | 'bench' | 'test'
+    #: The byte-identity contract, per knob: False declares the knob
+    #: *cost-only* — it may change tiers, batching, timing, or memory,
+    #: never output bytes — and the determinism taint auditor
+    #: (racon_tpu/analysis/determinism, Engine 5) statically rejects
+    #: any dataflow path from its read sites into the consensus/CIGAR
+    #: install seams (`determinism-leak`).  True declares it
+    #: output-affecting: a runtime-scoped True knob must then be
+    #: covered by every complete fingerprint composition in
+    #: racon_tpu/fingerprint.py (`fingerprint-gap` otherwise).
+    affects_output: bool = False
 
 
 def _k(name: str, default: Optional[str], kind: str, doc: str,
-       scope: str = "runtime") -> Knob:
+       scope: str = "runtime", affects_output: bool = False) -> Knob:
     assert name.startswith(PREFIX), name
-    return Knob(name, default, kind, doc, scope)
+    return Knob(name, default, kind, doc, scope, affects_output)
 
 
 #: The registry.  Order matters only for documentation output.
@@ -251,13 +261,17 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
     _k("RACON_TPU_FULL_GOLDEN", None, "bool",
        "run the slow golden scenarios", scope="test"),
     _k("RACON_TPU_TEST_DATA", "/root/reference/test/data/", "str",
-       "directory holding the lambda-phage fixture data", scope="test"),
+       "directory holding the lambda-phage fixture data", scope="test",
+       affects_output=True),
     _k("RACON_TPU_BENCH_MBP", "0.5", "float",
-       "benchmark workload size in polished megabases", scope="bench"),
+       "benchmark workload size in polished megabases", scope="bench",
+       affects_output=True),
     _k("RACON_TPU_BENCH_INPUT", "paf", "str",
-       "benchmark overlap format: paf | sam", scope="bench"),
+       "benchmark overlap format: paf | sam", scope="bench",
+       affects_output=True),
     _k("RACON_TPU_BENCH_PROFILE", "ont", "str",
-       "benchmark read profile: ont | sr", scope="bench"),
+       "benchmark read profile: ont | sr", scope="bench",
+       affects_output=True),
     _k("RACON_TPU_BENCH_LOG", None, "str",
        "append one bench JSON line per run to this file", scope="bench"),
     _k("RACON_TPU_BENCH_FORCE_DEVICE", None, "bool",

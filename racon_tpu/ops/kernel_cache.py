@@ -18,7 +18,7 @@ from __future__ import annotations
 import functools
 import time
 
-from .. import obs
+from .. import fingerprint, obs
 
 
 def device_keyed_cache(maxsize: int = 64):
@@ -46,7 +46,11 @@ def device_keyed_cache(maxsize: int = 64):
             # monotonic stamps taken around it.
             misses0 = cached.cache_info().misses
             t0 = time.monotonic_ns()
-            built = cached(len(devs), devs[0].platform, *args, **kwargs)
+            # the implicit topology prefix is the `kernel_cache` site of
+            # the unified fingerprint registry (racon_tpu/fingerprint.py)
+            topo = fingerprint.kernel_cache_key(len(devs),
+                                                devs[0].platform)
+            built = cached(*topo, *args, **kwargs)
             if cached.cache_info().misses != misses0:
                 # shape/cost extraction for the analytic cost model:
                 # the predicted per-unit bill rides in the same span as

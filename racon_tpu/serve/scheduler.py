@@ -72,7 +72,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from .. import obs
+from .. import fingerprint, obs
 from ..fleet import fleet_tenant_quota
 from ..resilience import budget as membudget
 from ..fleet.queues import TenantQueues
@@ -628,11 +628,16 @@ class Scheduler:
         written to a .part file and renamed only on success."""
         spec = job.spec
         a = spec.polish_args()
-        jd = self.session.job_dir(job.id)
+        # host lane = cpu backend: same `serve_job_dir` fingerprint site
+        # as the in-process lane, so a demoted re-run resumes the
+        # cpu-keyed journal and never replays device-tier records
+        paths = fingerprint.serve_job_paths(self.session.workdir, job.id,
+                                            "cpu")
+        jd = paths["dir"]
         os.makedirs(jd, exist_ok=True)
-        out_path = os.path.join(jd, "polished.fasta")
+        out_path = paths["output"]
         part_path = out_path + ".part"
-        report_path = os.path.join(jd, "report.json")
+        report_path = paths["report"]
         stderr_path = os.path.join(jd, "host.stderr.log")
         cmd = [sys.executable, "-m", "racon_tpu.cli",
                "-w", str(a["window_length"]),
@@ -641,8 +646,8 @@ class Scheduler:
                "-m", str(a["match"]), "-x", str(a["mismatch"]),
                "-g", str(a["gap"]), "-t", str(a["num_threads"]),
                "--report", report_path,
-               "--resume-journal", os.path.join(jd, "journal.cpu.jsonl"),
-               "--trace", os.path.join(jd, "trace.json")]
+               "--resume-journal", paths["journal"],
+               "--trace", paths["trace"]]
         if not a["trim"]:
             cmd.append("--no-trimming")
         if a["fragment_correction"]:

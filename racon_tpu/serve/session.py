@@ -28,7 +28,7 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-from .. import config, obs
+from .. import config, fingerprint, obs
 from ..obs import context, flight
 from ..polisher import create_polisher
 
@@ -176,7 +176,8 @@ class PolishSession:
     # -- layout ------------------------------------------------------------
 
     def job_dir(self, job_id: str) -> str:
-        return os.path.join(self.workdir, "jobs", job_id)
+        # the `serve_job_dir` site of the unified fingerprint registry
+        return fingerprint.serve_job_paths(self.workdir, job_id)["dir"]
 
     # -- startup warm-up ---------------------------------------------------
 
@@ -228,13 +229,14 @@ class PolishSession:
 
     def _run_job_locked(self, spec: JobSpec, cancel) -> dict:
         job_id = spec.job_id or f"job{self.jobs_run:04d}"
-        jd = self.job_dir(job_id)
-        os.makedirs(jd, exist_ok=True)
         backend = spec.backend or self.backend
-        out_path = os.path.join(jd, "polished.fasta")
-        trace_path = os.path.join(jd, "trace.json")
-        journal_path = os.path.join(jd, f"journal.{backend}.jsonl")
-        report_path = os.path.join(jd, "report.json")
+        paths = fingerprint.serve_job_paths(self.workdir, job_id, backend)
+        jd = paths["dir"]
+        os.makedirs(jd, exist_ok=True)
+        out_path = paths["output"]
+        trace_path = paths["trace"]
+        journal_path = paths["journal"]
+        report_path = paths["report"]
 
         cold = self.jobs_run == 0
         t0 = time.monotonic()

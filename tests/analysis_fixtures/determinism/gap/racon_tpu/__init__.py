@@ -1,0 +1,1 @@
+"""fingerprint-gap fixture package root."""

@@ -1,0 +1,14 @@
+"""Mini knob registry: one runtime knob declared output-affecting.
+
+The fixture's fingerprint.py does not cover it, so the declared-
+complete site must raise exactly one fingerprint-gap."""
+
+
+def _k(name, default, kind, doc, scope="runtime", affects_output=False):
+    return (name, default, kind, doc, scope, affects_output)
+
+
+KNOBS = {k[0]: k for k in (
+    _k("RACON_TPU_SEED", "0", "int",
+       "RNG seed baked into output bytes", affects_output=True),
+)}

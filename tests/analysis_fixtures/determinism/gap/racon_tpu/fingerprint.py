@@ -1,0 +1,15 @@
+"""Mini fingerprint registry missing the declared-output knob."""
+
+OUTPUT_SOURCES = (
+    "input:reads",
+)
+
+SITES = {
+    "journal": {
+        "helper": "journal_fingerprint",
+        "complete": True,
+        "components": {
+            "input_bytes": ("input:reads",),
+        },
+    },
+}

@@ -1,0 +1,1 @@
+"""determinism-leak fixture package root."""

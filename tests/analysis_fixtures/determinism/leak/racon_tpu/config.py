@@ -1,0 +1,15 @@
+"""Mini knob registry: one cost-only knob the code below leaks."""
+
+
+def _k(name, default, kind, doc, scope="runtime", affects_output=False):
+    return (name, default, kind, doc, scope, affects_output)
+
+
+KNOBS = {k[0]: k for k in (
+    _k("RACON_TPU_DEPTH", "2", "int",
+       "pipeline depth (declared cost-only)"),
+)}
+
+
+def get_int(name):
+    return 2

@@ -1,0 +1,1 @@
+"""determinism-leak fixture ops package."""

@@ -1,0 +1,12 @@
+"""The seeded contract break: a declared-cost-only knob's value is
+concatenated into the installed consensus payload — exactly one
+determinism-leak must fire, at the set_consensus call."""
+
+from .. import config
+
+
+def polish(pipeline, windows):
+    depth = config.get_int("RACON_TPU_DEPTH")
+    for i, w in enumerate(windows):
+        payload = w + str(depth).encode()
+        pipeline.set_consensus(i, payload, True)

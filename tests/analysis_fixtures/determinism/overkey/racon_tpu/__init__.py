@@ -1,0 +1,1 @@
+"""fingerprint-overkey fixture package root."""

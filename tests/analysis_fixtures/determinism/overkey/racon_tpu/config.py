@@ -1,0 +1,11 @@
+"""Mini knob registry: one cost-only knob nothing ever leaks."""
+
+
+def _k(name, default, kind, doc, scope="runtime", affects_output=False):
+    return (name, default, kind, doc, scope, affects_output)
+
+
+KNOBS = {k[0]: k for k in (
+    _k("RACON_TPU_TIER", "auto", "str",
+       "kernel tier selector (cost-only, taint-clean)"),
+)}

@@ -1,0 +1,17 @@
+"""Mini fingerprint registry keying a cache on a cost-only knob:
+exactly one fingerprint-overkey warning, at the `tier` component."""
+
+OUTPUT_SOURCES = (
+    "input:reads",
+)
+
+SITES = {
+    "cache": {
+        "helper": "cache_key",
+        "complete": False,
+        "components": {
+            "args": ("args:builder",),
+            "tier": ("knob:RACON_TPU_TIER",),
+        },
+    },
+}

@@ -1,0 +1,235 @@
+"""Engine 5 determinism taint auditor (racon_tpu/analysis/determinism).
+
+Each rule is proven on a seeded fixture mini-tree under
+tests/analysis_fixtures/determinism/ (firing exactly once), the real
+tree is proven clean (its only knob->sink flows are the documented
+journal-replay waivers), and every seeded mutant of the real tree is
+caught by the rule that claims it — the acceptance gate CI runs via
+`python -m racon_tpu.analysis --determinism` + `--det-mutate`.
+"""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from racon_tpu.analysis import astcache
+from racon_tpu.analysis.__main__ import main as analysis_main
+from racon_tpu.analysis.determinism import (
+    MUTANTS, build_audit, run_determinism, run_mutant)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXROOT = os.path.join(REPO, "tests", "analysis_fixtures", "determinism")
+
+
+@pytest.fixture(scope="module")
+def real_audit():
+    """One full-tree audit shared by every real-tree assertion."""
+    return build_audit(REPO)
+
+
+# ------------------------------------------------- seeded fixture trees
+
+def test_leak_fixture_fires_exactly_once():
+    res = build_audit(os.path.join(FIXROOT, "leak"))
+    assert [v.rule for v in res.violations] == ["determinism-leak"], \
+        [v.render() for v in res.violations]
+    assert not res.warnings
+    v = res.violations[0]
+    assert "RACON_TPU_DEPTH" in v.message
+    assert v.path == "racon_tpu/ops/code.py"
+    assert "set_consensus" in v.message
+
+
+def test_gap_fixture_fires_exactly_once():
+    res = build_audit(os.path.join(FIXROOT, "gap"))
+    assert [v.rule for v in res.violations] == ["fingerprint-gap"], \
+        [v.render() for v in res.violations]
+    assert not res.warnings
+    v = res.violations[0]
+    assert "knob:RACON_TPU_SEED" in v.message
+    assert v.path == "racon_tpu/fingerprint.py"
+
+
+def test_overkey_fixture_warns_exactly_once():
+    res = build_audit(os.path.join(FIXROOT, "overkey"))
+    assert not res.violations, [v.render() for v in res.violations]
+    assert [v.rule for v in res.warnings] == ["fingerprint-overkey"], \
+        [v.render() for v in res.warnings]
+    assert "RACON_TPU_TIER" in res.warnings[0].message
+
+
+def test_fixture_waiver_silences_the_leak(tmp_path):
+    """A `# determinism:` waiver above the sink line kills the leak
+    finding but the manifest still records the waived flow — the
+    documented escape hatch works end to end."""
+    src = os.path.join(FIXROOT, "leak")
+    tree = tmp_path / "tree"
+    shutil.copytree(src, tree)
+    code = tree / "racon_tpu" / "ops" / "code.py"
+    text = code.read_text()
+    code.write_text(text.replace(
+        "        pipeline.set_consensus(i, payload, True)",
+        "        # determinism: fixture demonstrates a waived flow\n"
+        "        pipeline.set_consensus(i, payload, True)"))
+    res = build_audit(str(tree))
+    assert not res.violations, [v.render() for v in res.violations]
+    flows = res.manifest["knobs"]["RACON_TPU_DEPTH"]["sink_flows"]
+    assert len(flows) == 1
+    assert flows[0]["waived"] == "fixture demonstrates a waived flow"
+
+
+# ------------------------------------------------- the real tree
+
+def test_real_tree_is_clean(real_audit):
+    assert not real_audit.violations, \
+        [v.render() for v in real_audit.violations]
+    assert not real_audit.warnings, \
+        [v.render() for v in real_audit.warnings]
+
+
+def test_real_tree_journal_flows_are_waived(real_audit):
+    """The one intentional knob->sink flow (journal replay installs
+    journaled bytes) is present AND waived — the auditor sees the flow
+    rather than missing it."""
+    flows = real_audit.manifest["knobs"]["RACON_TPU_JOURNAL"][
+        "sink_flows"]
+    seams = {f["seam"] for f in flows}
+    assert seams == {"set_consensus", "set_job_cigar"}, flows
+    assert all(f.get("waived") for f in flows), flows
+
+
+def test_manifest_classifies_every_registered_knob(real_audit):
+    from racon_tpu.config import KNOBS
+    man = real_audit.manifest
+    assert set(KNOBS) <= set(man["knobs"])
+    for name, entry in man["knobs"].items():
+        assert entry["verdict"] in ("cost-only", "output-affecting"), \
+            (name, entry)
+    # runtime knobs all honor the byte-identity contract
+    for name, knob in KNOBS.items():
+        if knob.scope == "runtime":
+            assert man["knobs"][name]["affects_output"] is False, name
+
+
+def test_manifest_lists_every_fingerprint_site(real_audit):
+    from racon_tpu import fingerprint
+    man = real_audit.manifest
+    assert set(man["sites"]) == set(fingerprint.SITES)
+    for name, site in man["sites"].items():
+        assert site["components"], name
+        assert site["expanded_coverage"], name
+    # complete sites cover the whole required domain
+    domain = set(man["required_domain"])
+    for name, site in man["sites"].items():
+        if site["complete"]:
+            assert domain <= set(site["expanded_coverage"]), name
+
+
+def test_declared_knob_missing_from_fingerprint_is_a_gap(tmp_path):
+    """Registry->domain coupling: declaring any runtime knob
+    affects_output=True without extending the fingerprint compositions
+    must raise fingerprint-gap on every complete site."""
+    tree = tmp_path / "tree"
+    (tree / "racon_tpu").parent.mkdir(parents=True, exist_ok=True)
+    shutil.copytree(os.path.join(REPO, "racon_tpu"), tree / "racon_tpu")
+    cfg = tree / "racon_tpu" / "config.py"
+    cfg.write_text(cfg.read_text()
+                   + "\n_GAP = _k(\"RACON_TPU_GAP_MUTANT\", \"0\", "
+                     "\"int\", \"seeded\", affects_output=True)\n")
+    res = build_audit(str(tree))
+    gaps = [v for v in res.violations if v.rule == "fingerprint-gap"]
+    sites = {v.message.split("`")[1] for v in gaps}
+    assert sites == {"journal", "serve_job_dir"}, \
+        [v.render() for v in res.violations]
+    assert all("knob:RACON_TPU_GAP_MUTANT" in v.message for v in gaps)
+
+
+# ------------------------------------------------- seeded mutants
+
+@pytest.mark.parametrize("name", [m[0] for m in MUTANTS])
+def test_seeded_mutant_is_caught(name):
+    mutant, audit, caught = run_mutant(REPO, name)
+    assert caught, (
+        f"mutant {name} expected {mutant[2]} but audit found only: "
+        + "; ".join(v.render()
+                    for v in audit.violations + audit.warnings))
+    rules = {v.rule for v in audit.violations + audit.warnings}
+    assert mutant[2] in rules
+
+
+def test_unknown_mutant_is_rejected():
+    with pytest.raises(ValueError):
+        run_mutant(REPO, "no-such-mutant")
+
+
+# ------------------------------------------------- CLI wiring
+
+def test_cli_determinism_clean_exit_zero(capsys):
+    rc = analysis_main(["--determinism", "--repo-root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "OK" in out
+
+
+def test_cli_mutant_exits_nonzero(capsys):
+    rc = analysis_main(["--det-mutate", "leak-pipeline-depth",
+                        "--repo-root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "CAUGHT" in out
+
+
+def test_cli_list_det_mutations(capsys):
+    rc = analysis_main(["--list-det-mutations"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for m in MUTANTS:
+        assert m[0] in out
+
+
+def test_cli_manifest_round_trip(tmp_path, capsys, real_audit):
+    dest = tmp_path / "determinism.json"
+    rc = analysis_main(["--determinism", "--emit-manifest", str(dest),
+                        "--repo-root", REPO])
+    capsys.readouterr()
+    assert rc == 0
+    loaded = json.loads(dest.read_text())
+    assert loaded == real_audit.manifest
+    assert loaded["version"] == 1
+
+
+def test_cli_paths_scoped_run(capsys):
+    rc = analysis_main(["--determinism", "--paths",
+                        "racon_tpu/resilience/journal.py",
+                        "racon_tpu/polisher.py",
+                        "--repo-root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+
+
+def test_run_determinism_entry_point_shape():
+    vs = run_determinism(os.path.join(FIXROOT, "leak"))
+    assert [v.rule for v in vs] == ["determinism-leak"]
+    # warnings never leak through the hard-violation entry point
+    assert run_determinism(os.path.join(FIXROOT, "overkey")) == []
+
+
+# ------------------------------------------------- astcache hardening
+
+def test_astcache_same_size_same_mtime_rewrite_reparses(tmp_path):
+    """A same-length rewrite with os.utime-restored mtime must still
+    invalidate (ctime/inode guard): no engine may see a stale tree."""
+    p = tmp_path / "m.py"
+    p.write_text("x = 1\n")
+    st = os.stat(p)
+    first = astcache.load(str(tmp_path), "m.py")
+    assert "x = 1" in first.source
+    p.write_text("x = 2\n")            # same size
+    os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns))
+    st2 = os.stat(p)
+    assert st2.st_mtime_ns == st.st_mtime_ns
+    assert st2.st_size == st.st_size
+    second = astcache.load(str(tmp_path), "m.py")
+    assert "x = 2" in second.source

@@ -77,6 +77,26 @@ run "racon_tpu.analysis (concurrency + contracts)" \
     env JAX_PLATFORMS=cpu python -m racon_tpu.analysis \
         --concurrency --contracts
 
+# 1e. Determinism taint audit: the byte-identity contract (no
+#     cost-only knob value may reach the consensus/CIGAR install
+#     seams; every complete fingerprint composition covers the
+#     output-affecting domain), plus the seeded-mutant self-test —
+#     each planted contract bug must be CAUGHT (non-zero exit).
+run "racon_tpu.analysis (determinism)" \
+    env JAX_PLATFORMS=cpu python -m racon_tpu.analysis --determinism
+det_mutants() {
+    for m in drop-input-bytes leak-pipeline-depth overkey-tier \
+             drop-journal-waiver; do
+        if env JAX_PLATFORMS=cpu python -m racon_tpu.analysis \
+            --det-mutate "$m" > /dev/null; then
+            echo "   determinism mutant $m: MISSED"
+            return 1
+        fi
+    done
+    return 0
+}
+run "racon_tpu.analysis (determinism mutants)" det_mutants
+
 # 2. ruff (style + pyflakes), configured in pyproject.toml.
 if command -v ruff >/dev/null 2>&1; then
     run "ruff" ruff check .
