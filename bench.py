@@ -402,6 +402,14 @@ def normalize_entry(e: dict) -> dict:
         # entries written before the elastic pool existed: explicit null
         # ("no pool-size timeline"), same as a run with the fleet off
         e = dict(e, pool=None)
+    if ("serve" in e or "distrib" in e) and "ledger" not in e:
+        # entries written before the per-job latency ledger existed:
+        # explicit null ("no stage decomposition recorded")
+        e = dict(e, ledger=None)
+    if ("serve" in e or "distrib" in e) and "slo" not in e:
+        # entries written before the per-tenant SLO engine existed:
+        # explicit null ("no burn-rate snapshot scraped")
+        e = dict(e, slo=None)
     if "peak_rss_mb" not in e or "budget_mb" not in e:
         # entries written before the memory budget existed: recover the
         # pair from the embedded report's memory phase when the run
@@ -742,6 +750,11 @@ def serve_profile(jobs: int = 4, clients: int = 2) -> int:
         "fleet": summary.get("daemon_stats"),
         # elastic pool-size timeline (None: daemon ran without a plane)
         "pool": summary.get("pool"),
+        # aggregated per-job latency ledger + end-of-run SLO snapshot
+        # (None on daemons predating either; normalize_entry backfills
+        # old logs to the same nulls)
+        "ledger": summary.get("ledger"),
+        "slo": summary.get("slo"),
         **({"device_status": "unreachable"} if degraded else {}),
     }
     assert normalize_entry(dict(entry)) == entry, \
@@ -752,6 +765,7 @@ def serve_profile(jobs: int = 4, clients: int = 2) -> int:
         "kernel": config.get_str("RACON_TPU_POA_KERNEL") or "ls",
         "serve": serve_stats, "fleet": summary.get("daemon_stats"),
         "pool": summary.get("pool"),
+        "ledger": summary.get("ledger"), "slo": summary.get("slo"),
         "cost_model": None, "pack_split": None,
         "serial_steps": None,
         **({"device_status": "unreachable"} if degraded else {}),
@@ -797,6 +811,8 @@ def distrib_profile(workers: int = 3) -> int:
                 polished_bp += len(line.strip())
     value = polished_bp / 1e6 / wall if wall > 0 else 0.0
     counters = result["counters"]
+    from racon_tpu.obs import ledger as joblog
+    dist_stage_s = joblog.stage_seconds(result.get("summary"))
     distrib_stats = {
         "workers": workers,
         "chunks": result["chunks"],
@@ -833,6 +849,11 @@ def distrib_profile(workers: int = 3) -> int:
         # elastic pool bounds + size timeline (fixed-size here: the
         # distrib bench pins min == max == workers)
         "pool": result.get("pool"),
+        # per-stage compute seconds off the gathered run report (the
+        # distrib lane has no per-job queueing stamps, and no daemon to
+        # scrape an SLO snapshot from — slo stays an explicit null)
+        "ledger": (({"stage_s": dist_stage_s} if dist_stage_s else None)),
+        "slo": None,
     }
     assert normalize_entry(dict(entry)) == entry, \
         "distrib bench entry must be a normalize_entry fixed point"
@@ -841,6 +862,8 @@ def distrib_profile(workers: int = 3) -> int:
         "value": round(value, 4), "vs_baseline": None,
         "kernel": "host", "distrib": distrib_stats,
         "fleet": result.get("telemetry"), "pool": result.get("pool"),
+        "ledger": ({"stage_s": dist_stage_s} if dist_stage_s else None),
+        "slo": None,
         "cost_model": None, "pack_split": None, "serial_steps": None,
     })
     print(json.dumps(entry))

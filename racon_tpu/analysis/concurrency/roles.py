@@ -37,6 +37,8 @@ THREAD_ROLE_PATTERNS = {
     "poa-warm": "pipelined-phases consensus warm thread (polisher.py)",
     "align-worker": "pipelined-phases alignment feeder (polisher.py)",
     "racon-tpu-watchdog-call": "device-call watchdog runner",
+    "serve-metrics-http": "Prometheus exposition HTTP listener "
+                          "(serve/server.py)",
     "loadtest-c*": "serve load-test client thread (serve/loadtest.py)",
     "loadtest-stats": "load-test daemon telemetry poller "
                       "(serve/loadtest.py)",

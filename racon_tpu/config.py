@@ -194,6 +194,32 @@ KNOBS: Dict[str, Knob] = {k.name: k for k in (
     _k("RACON_TPU_TELEMETRY_RING", "64", "int",
        "live-telemetry ring capacity: periodic metrics snapshots kept "
        "per process, scraped through the serve/distrib 'stats' verb"),
+    # -- SLO / exposition knobs (obs/slo.py, obs/export.py) ---------------
+    _k("RACON_TPU_SLO_LATENCY_S", None, "str",
+       "per-tenant job-latency SLO targets in seconds: a bare float is "
+       "the default target, key=value pairs set per-tenant targets "
+       "(e.g. 'default=2.5,tenant-a=1.0'); unset = no latency objective"),
+    _k("RACON_TPU_SLO_AVAILABILITY", "0.99", "float",
+       "SLO availability objective: the fraction of jobs that must "
+       "finish inside their latency target (error budget = 1 - this)"),
+    _k("RACON_TPU_SLO_FAST_WINDOW_S", "60", "float",
+       "fast burn-rate window in seconds (the reactive half of the "
+       "multi-window alert)"),
+    _k("RACON_TPU_SLO_SLOW_WINDOW_S", "600", "float",
+       "slow burn-rate window in seconds (the confirming half of the "
+       "multi-window alert)"),
+    _k("RACON_TPU_SLO_BURN_ALERT", "2.0", "float",
+       "burn-rate alert threshold: both windows burning past it fires "
+       "the slo.alert event and drives the fleet autoscaler (0 disables "
+       "SLO alerting)"),
+    _k("RACON_TPU_SLO_SHED_BURN", "0", "float",
+       "burn-rate shedding threshold: new submissions shed (counted "
+       "shed_slo) while both windows burn past it (0 = never shed on "
+       "SLO burn)"),
+    _k("RACON_TPU_METRICS_PORT", "0", "int",
+       "Prometheus exposition HTTP port on the serve daemon (GET "
+       "/metrics, localhost only; 0 = disabled, the `metrics` wire op "
+       "still serves the same text)"),
     # -- serving knobs ----------------------------------------------------
     _k("RACON_TPU_SERVE_PORT", "0", "int",
        "TCP port for the `racon-tpu serve` daemon (0 = pick a free "

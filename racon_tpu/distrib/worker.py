@@ -37,7 +37,7 @@ import threading
 import time
 
 from .. import obs
-from ..obs import context, flight
+from ..obs import context, flight, ledger
 from .common import WireError, rpc
 
 
@@ -86,6 +86,12 @@ def _polish_chunk(a: dict) -> dict:
         sum(rep.wall_s.values())
         for name, rep in polisher.report.phases.items()
         if name in ("alignment", "consensus"))
+    # ledger fragment: per-stage compute seconds off this chunk's own
+    # report, plus the build/replay overlays from the span histograms
+    # (obs/ledger.py vocabulary) — the fleet plane folds these into the
+    # owning job's latency ledger
+    stage_s = ledger.stage_seconds(polisher.report.summary())
+    stage_s.update(ledger.overlay_seconds(obs.snapshot()))
     # per-worker peak RSS rides back in the stats (the coordinator /
     # fleet plane track the max per worker into fleet_telemetry()) and
     # lands as a trace instant for the `obs fleet` per-pid RSS column
@@ -98,6 +104,7 @@ def _polish_chunk(a: dict) -> dict:
         "journal_replayed": replayed,
         "kernel_wall_s": round(kernel_wall, 4),
         "rss_mb": rss,
+        "stage_s": stage_s,
     }
 
 

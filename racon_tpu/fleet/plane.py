@@ -56,7 +56,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from .. import obs
-from ..obs import context, flight
+from ..obs import context, flight, slo
 from ..polisher import _split_fasta
 from ..resilience.report import PhaseReport, RunReport
 from ..serve.protocol import read_message, write_message
@@ -103,6 +103,20 @@ class FleetJob:
         self.done = threading.Event()
         self.t_submit = time.monotonic()
         self.t_end: Optional[float] = None
+        # ledger stage_s fragment (obs/ledger.py): per-stage seconds
+        # accumulated across this job's chunks — plane queue waits plus
+        # the workers' report-derived compute stages.  Chunks run in
+        # parallel, so these are resource-seconds, not wall slices.
+        self.stage_s: Dict[str, float] = {}
+
+    def add_stage(self, stage: str, seconds) -> None:
+        # call with the plane's _cv held
+        try:
+            s = float(seconds)
+        except (TypeError, ValueError):
+            return
+        if s >= 0:
+            self.stage_s[stage] = self.stage_s.get(stage, 0.0) + s
 
     def unfinished(self) -> int:
         return sum(1 for c in self.chunks if c.state != "done")
@@ -477,18 +491,25 @@ class FleetPlane:
         c.leases[attempt] = Lease(worker, attempt, self.lease_ttl,
                                   canonical)
         self._affinity[worker] = c.job.id
-        self.queue_waits.append(max(
-            0.0, time.monotonic() - max(c.t_pending, c.next_eligible)))
+        wait = max(0.0, time.monotonic() - max(c.t_pending,
+                                               c.next_eligible))
+        self.queue_waits.append(wait)
+        # plane-side queueing rides the job ledger's dispatch stage:
+        # with a plane attached the scheduler's own dispatch is instant
+        # and the real wait happens here, per chunk
+        c.job.add_stage("dispatch", wait)
         self._count("dispatches")
         if attempt > 1 and not speculative:
             self._count("redispatches")
         # same dispatch/span contract as the distrib coordinator: the
         # worker stamps this span id as its distrib.chunk parent, so
-        # `obs fleet` parents the merged plane trace identically
+        # `obs fleet` parents the merged plane trace identically; the
+        # job id lets `obs critpath` group chunk spans per job
         ctx = context.child(self._ctx)
         obs.event("distrib.dispatch", chunk=c.index, worker=worker,
                   attempt=attempt, speculative=speculative,
-                  canonical_journal=canonical,
+                  canonical_journal=canonical, job=c.job.id,
+                  tenant=c.job.tenant,
                   trace_id=(ctx or {}).get("trace_id"),
                   span_id=(ctx or {}).get("parent"))
         return {"ok": True, "chunk": {
@@ -544,6 +565,13 @@ class FleetPlane:
             if replayed:
                 self._count("journal_replayed", replayed)
             self._count("chunks_fleet")
+            # fold the worker's report-derived stage durations into the
+            # job's ledger fragment (shipped onward in _gather)
+            frag = stats.get("stage_s")
+            if isinstance(frag, dict):
+                for stage, s in frag.items():
+                    if isinstance(stage, str):
+                        c.job.add_stage(stage, s)
             ws = self.worker_stats.setdefault(
                 int(req["worker"]),
                 {"chunks": 0, "wall_s": 0.0, "kernel_wall_s": 0.0,
@@ -722,11 +750,23 @@ class FleetPlane:
                                             int(0.95 * len(waits)))]
             if backlog > 0:
                 self._idle_ticks = 0
+                # SLO burn is a first-class scale trigger: a multi-window
+                # burn-rate alert grows the pool even before the queueing
+                # p95 trips (obs/slo.py; the cause string makes the
+                # slo-driven growth visible in counters and the trace)
+                slo_burn = slo.engine().alerting("")
+                if slo_burn:
+                    self._count("slo_alert_ticks")
                 if active == 0 or p95_ms > fleet_scale_p95_ms() \
-                        or backlog >= 4 * active:
+                        or backlog >= 4 * active \
+                        or (slo_burn and live < self.pool.max_workers):
                     cause = (f"backlog {backlog}, active {active}, "
                              f"queueing p95 {p95_ms:.0f}ms")
+                    if slo_burn:
+                        cause = f"slo_burn: {cause}"
                     spawned = self.pool.scale_up(1, cause=cause)
+                    if slo_burn and spawned:
+                        self._count("scale_up_slo")
                     if active == 0 and spawned == 0 and live == 0:
                         self._respawn_failures += 1
                         if self._respawn_failures >= 3:
@@ -923,6 +963,8 @@ class FleetPlane:
             "trace": None,
             "summary": None,
             "fleet": {"chunks": len(job.chunks), "served": served},
+            "ledger": {"stage_s": {k: round(v, 6) for k, v in
+                                   sorted(job.stage_s.items())}},
         }
 
     # -- telemetry ----------------------------------------------------------

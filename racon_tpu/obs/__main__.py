@@ -15,6 +15,7 @@ Subcommands (the cost-model surface, same exit-code contract)::
     python -m racon_tpu.obs bench [extra.json ...] [--threshold T]
     python -m racon_tpu.obs merge --out MERGED.json T1.json T2.json ...
     python -m racon_tpu.obs fleet MERGED.json [--json]
+    python -m racon_tpu.obs critpath MERGED.json [--json]
 
 Exit codes (CI keys off these):
 
@@ -25,7 +26,8 @@ Exit codes (CI keys off these):
 * 2 — file unreadable / not JSON / not a trace object / bad arguments
 * 3 — regression: ``--diff`` phase regression past ``--threshold``,
   ``validate`` prediction error past the machine profile's declared
-  bound, or ``bench`` history regression
+  bound, ``bench`` history regression, or ``critpath`` unattributed
+  wall time past ``--max-unattributed``
 """
 
 from __future__ import annotations
@@ -36,7 +38,7 @@ import sys
 from typing import Dict, List, Tuple
 
 from . import PHASES
-from . import bench_track, costmodel
+from . import bench_track, costmodel, critpath
 from .metrics import hist_quantile
 
 _VALID_PH = {"X", "B", "E", "i", "I", "M", "C"}
@@ -338,6 +340,8 @@ def merge_traces(docs: List[dict], paths: List[str]) -> dict:
     base = min(known) if known else None
     events: List[dict] = []
     processes: List[dict] = []
+    counters: Dict[str, int] = {}
+    platform = None
     dropped = 0
     for doc, path, t0 in zip(docs, paths, t0s):
         dt_us = ((t0 - base) // 1000) if (t0 is not None
@@ -351,21 +355,36 @@ def merge_traces(docs: List[dict], paths: List[str]) -> dict:
                 ev["ts"] = max(0, int(ev["ts"]) + dt_us)
             events.append(ev)
         dropped += dropped_events(doc)
+        # counters are exact and additive, so the merged document can
+        # carry the fleet-wide sums (critpath's cost-model cross-check
+        # reads them); histograms don't merge losslessly and are left out
+        for name, v in _counters(doc).items():
+            try:
+                counters[name] = counters.get(name, 0) + int(v)
+            except (TypeError, ValueError):
+                continue
         od = doc.get("otherData") if isinstance(doc.get("otherData"),
                                                 dict) else {}
+        platform = platform or od.get("platform")
         processes.append({
             "path": path, "pid": od.get("pid"), "role": od.get("role"),
             "trace_id": od.get("trace_id"), "t0_monotonic_ns": t0,
             "offset_us": dt_us, "events": len(doc.get("traceEvents", [])),
         })
-    return {
+    other = {"tool": "racon_tpu.obs", "clock": "monotonic",
+             "dropped_events": dropped, "merged_from": list(paths)}
+    if platform:
+        other["platform"] = platform
+    merged = {
         "traceEvents": events,
         "displayTimeUnit": "ms",
-        "otherData": {"tool": "racon_tpu.obs", "clock": "monotonic",
-                      "dropped_events": dropped,
-                      "merged_from": list(paths)},
+        "otherData": other,
         "racon_tpu": {"processes": processes},
     }
+    if counters:
+        merged["racon_tpu"]["metrics"] = {
+            "counters": dict(sorted(counters.items()))}
+    return merged
 
 
 def cmd_merge(args) -> int:
@@ -527,6 +546,41 @@ def cmd_fleet(args) -> int:
     return 1 if b["violations"] else 0
 
 
+def cmd_critpath(args) -> int:
+    try:
+        doc, errors = load_trace(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"[obs] cannot read trace {args.trace}: {e}", file=sys.stderr)
+        return 2
+    if errors:
+        for err in errors:
+            print(f"[obs] {args.trace}: {err}", file=sys.stderr)
+        return 1
+    try:
+        result = critpath.analyze(doc, profile=args.profile)
+    except KeyError as e:
+        print(f"[obs] {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(critpath.render(result, args.trace, args.max_unattributed))
+    over = [j for j in result["jobs"]
+            if j["unattributed_frac"] > args.max_unattributed]
+    if over:
+        for j in over:
+            print(f"[obs] UNATTRIBUTED: job {j['job']}: "
+                  f"{100 * j['unattributed_frac']:.1f}% of "
+                  f"{j['wall_us'] / 1e3:.2f} ms wall unexplained "
+                  f"(threshold {100 * args.max_unattributed:.0f}%)",
+                  file=sys.stderr)
+        return 3
+    if result["jobs"] and not args.as_json:
+        print(f"[obs] OK: every job attributed to within "
+              f"{100 * args.max_unattributed:.0f}% of its wall")
+    return 0
+
+
 def _sub_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m racon_tpu.obs",
@@ -595,13 +649,30 @@ def _sub_parser() -> argparse.ArgumentParser:
     fl.add_argument("trace")
     fl.add_argument("--json", action="store_true", dest="as_json")
     fl.set_defaults(fn=cmd_fleet)
+
+    cp = sub.add_parser("critpath",
+                        help="critical-path attribution over a merged "
+                             "fleet trace: per-job/per-stage latency "
+                             "decomposition via the dispatch->chunk "
+                             "parenting; exit 3 when unattributed wall "
+                             "exceeds --max-unattributed")
+    cp.add_argument("trace")
+    cp.add_argument("--profile", default="auto",
+                    help="machine profile for the cost-model "
+                         "cross-check (default: auto from the trace)")
+    cp.add_argument("--max-unattributed", type=float, default=0.10,
+                    help="tolerated unattributed fraction of each "
+                         "job's wall (default 0.10)")
+    cp.add_argument("--json", action="store_true", dest="as_json")
+    cp.set_defaults(fn=cmd_critpath)
     return p
 
 
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
-    if argv and argv[0] in ("model", "validate", "bench", "merge", "fleet"):
+    if argv and argv[0] in ("model", "validate", "bench", "merge", "fleet",
+                            "critpath"):
         try:
             args = _sub_parser().parse_args(argv)
         except SystemExit as e:

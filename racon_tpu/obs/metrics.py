@@ -49,10 +49,17 @@ class Histogram:
 
 
 def hist_quantile(hist: dict, q: float):
-    """Approximate quantile from a snapshotted log2 histogram dict
-    (``Histogram.as_dict()`` shape): the *upper bound* of the bucket
-    where the cumulative count crosses ``q`` — exact to within one log2
-    bucket, which is all the breakdown's p50/p99 columns promise.
+    """Quantile estimate from a snapshotted log2 histogram dict
+    (``Histogram.as_dict()`` shape), with linear interpolation inside
+    the winning bucket.
+
+    The cumulative count crosses ``q`` somewhere inside one log2 bucket
+    ``(lo, hi]`` (``lo = hi/2`` for ``hi >= 2``; the "1" bucket covers
+    ``(0, 1]``).  The old estimator returned ``hi``, so a p99 gate
+    jumped in 2x steps; interpolating the crossing fraction into the
+    bucket keeps the estimate inside the same bucket (so the error is
+    still bounded by the bucket width) while moving smoothly with the
+    data.  The result is clamped to the observed ``[min, max]``.
     Returns None for an empty/malformed histogram."""
     try:
         total = int(hist["count"])
@@ -64,13 +71,21 @@ def hist_quantile(hist: dict, q: float):
     need = max(1, math.ceil(q * total))
     seen = 0
     for bound in sorted(buckets, key=float):
-        seen += int(buckets[bound])
-        if seen >= need:
-            # the top bucket's true upper bound is the observed max
-            if hist.get("max") is not None:
-                return min(float(bound), float(hist["max"])) \
-                    if float(bound) else 0.0
-            return float(bound)
+        n = int(buckets[bound])
+        if seen + n >= need:
+            hi = float(bound)
+            if not hi:
+                return 0.0          # the "0" bucket holds only <=0 values
+            lo = hi / 2.0 if hi >= 2.0 else 0.0
+            frac = (need - seen) / n
+            v = lo + frac * (hi - lo)
+            hmin, hmax = hist.get("min"), hist.get("max")
+            if hmax is not None:
+                v = min(v, float(hmax))
+            if hmin is not None:
+                v = max(v, float(hmin))
+            return v
+        seen += n
     return hist.get("max")
 
 

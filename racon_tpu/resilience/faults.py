@@ -125,6 +125,13 @@ KNOWN_POINTS = frozenset({
     "mem.pressure",      # budget poll: forced hard-watermark breach
     "mem.spill",         # before parking a working set to the spill file
     "mem.oom",           # distrib worker, before polishing a chunk
+    # SLO seam (racon_tpu/obs/slo.py): the burn-rate engine checks
+    # slo.burn on every evaluation — a raise is absorbed as a forced
+    # burn (both windows report at least the alert threshold for one
+    # fast window, counted as burn_faults).  This is the deterministic
+    # injected-slowdown drill: the alert -> autoscale path fires
+    # without a real latency regression.
+    "slo.burn",          # SLO engine, forced burn-rate breach
 })
 
 

@@ -142,6 +142,10 @@ class RunReport:
         # flight-recorder dumps swept from the workdir after the run
         # (obs/flight.py `scan` docs) — each entry is one post-mortem
         self.flight: List[dict] = []
+        # per-job latency-ledger fragment (obs/ledger.py): the serve
+        # session stamps the compute side's stage_s decomposition here
+        # so the persisted report carries it; None outside serving
+        self.ledger: Optional[dict] = None
 
     def attach(self, phase_report: Optional[PhaseReport]) -> None:
         if phase_report is not None:
@@ -181,6 +185,10 @@ class RunReport:
                        for d in self.flight],
             "wall_s": round(self.wall_s if self.wall_s is not None
                             else time.monotonic() - self._t0, 3),
+            # latency-ledger fragment, present only when serving stamped
+            # one (obs/ledger.py) — keys absent rather than null so
+            # non-serve reports stay byte-for-byte what they were
+            **({"ledger": dict(self.ledger)} if self.ledger else {}),
         }
 
     def summary(self) -> dict:

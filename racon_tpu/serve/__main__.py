@@ -62,6 +62,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--fleet-min", type=int, default=None,
                    help="elastic fleet worker floor (default: "
                    "RACON_TPU_FLEET_MIN_WORKERS)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="Prometheus exposition HTTP port on 127.0.0.1 "
+                   "(GET /metrics; default: RACON_TPU_METRICS_PORT, "
+                   "0 = disabled — the `metrics` wire op still works)")
     p.add_argument("-m", "--match", type=int, default=3,
                    help="match score to warm kernels for (default 3)")
     p.add_argument("-x", "--mismatch", type=int, default=-5,
@@ -130,7 +134,8 @@ def main(argv=None) -> int:
         warm_window_lengths=tuple(args.warm_window or (500,)),
         warm_scores=(args.match, args.mismatch, args.gap),
         host_lane=not args.no_host_lane,
-        fleet_min=args.fleet_min, fleet_max=args.fleet_max)
+        fleet_min=args.fleet_min, fleet_max=args.fleet_max,
+        metrics_port=args.metrics_port)
 
     from ..obs import flight
     flight.set_role("serve")

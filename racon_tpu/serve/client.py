@@ -102,5 +102,10 @@ class ServeClient:
     def stats(self) -> dict:
         return self.rpc(op="stats")
 
+    def metrics(self) -> dict:
+        """Prometheus exposition text + SLO snapshot (``text``/``slo``
+        response fields)."""
+        return self.rpc(op="metrics")
+
     def shutdown(self) -> dict:
         return self.rpc(op="shutdown")

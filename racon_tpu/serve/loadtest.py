@@ -26,6 +26,7 @@ import threading
 import time
 from typing import List, Optional
 
+from ..obs import ledger as joblog
 from .client import ServeClient, ServeError
 
 DOCS_BEGIN = "<!-- serve-loadtest:begin -->"
@@ -33,12 +34,17 @@ DOCS_END = "<!-- serve-loadtest:end -->"
 
 
 def percentile(values: List[float], p: float) -> float:
-    """Nearest-rank percentile on a non-empty list."""
-    import math
-
+    """Linearly interpolated percentile on a non-empty list — the same
+    estimator `obs critpath` uses and `obs.metrics.hist_quantile`
+    approximates per bucket, so percentiles agree across the harness,
+    the analyzer, and the metrics registry."""
     vs = sorted(values)
-    k = max(0, min(len(vs) - 1, math.ceil(p / 100.0 * len(vs)) - 1))
-    return vs[k]
+    if len(vs) == 1:
+        return vs[0]
+    pos = (p / 100.0) * (len(vs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(vs) - 1)
+    return round(vs[lo] + (pos - lo) * (vs[hi] - vs[lo]), 6)
 
 
 def spawn_daemon(state_dir: str, backend: str = "tpu",
@@ -123,6 +129,7 @@ def run_loadtest(port: int, paths: dict, jobs: int, clients: int,
                         "kernel_builds": res.get("kernel_builds"),
                         "polished_bp": res.get("polished_bp", 0),
                         "backend": res.get("backend"),
+                        "ledger": res.get("ledger"),
                         "client": ci,
                         "tenant": tenant,
                         "priority": priority,
@@ -136,17 +143,36 @@ def run_loadtest(port: int, paths: dict, jobs: int, clients: int,
     def stats_loop() -> None:
         # live-telemetry scrape: the daemon's `stats` op once a second
         # while the clients drive it — queue depths and the telemetry
-        # ring under load, not just the end-state
+        # ring under load, not just the end-state.  Polling is
+        # observation and must never fail (or silently abandon) the
+        # run: errors are tolerated per sample — a slow or restarting
+        # daemon costs one data point and a reconnect, not the rest of
+        # the series — and the cadence follows a monotonic deadline so
+        # slow scrapes do not stretch the sampling interval.
+        c: Optional[ServeClient] = None
+        next_t = time.monotonic()
         try:
-            with ServeClient(port, timeout=timeout) as c:
-                while not stop_poll.is_set():
+            while not stop_poll.is_set():
+                try:
+                    if c is None:
+                        c = ServeClient(port, timeout=min(timeout, 15.0))
                     resp = c.stats()
                     resp.pop("ok", None)
                     resp["t"] = round(time.monotonic() - t_start, 3)
                     stats_samples.append(resp)  # concurrency: append-only; read after join
-                    stop_poll.wait(1.0)
-        except (ServeError, OSError):
-            return  # polling is observation; it must never fail the run
+                except (ServeError, OSError, ValueError):
+                    if c is not None:   # drop the sample, keep the series
+                        c.close()
+                        c = None
+                next_t += 1.0
+                delay = next_t - time.monotonic()
+                if delay <= 0:
+                    next_t = time.monotonic()  # fell behind: re-anchor
+                    delay = 0.05
+                stop_poll.wait(delay)
+        finally:
+            if c is not None:
+                c.close()
 
     threads = [threading.Thread(target=client_loop, args=(ci,),
                                 name=f"loadtest-c{ci}", daemon=True)
@@ -161,6 +187,16 @@ def run_loadtest(port: int, paths: dict, jobs: int, clients: int,
     makespan = time.monotonic() - t_start
     stop_poll.set()
     poller.join(timeout=5.0)
+
+    # end-of-run SLO scrape: burn rates + alert state off the daemon's
+    # own engine (the `metrics` wire op).  Tolerated failure -> None,
+    # so the harness still drives daemons predating the op.
+    slo_snap = None
+    try:
+        with ServeClient(port, timeout=min(timeout, 15.0)) as c:
+            slo_snap = c.metrics().get("slo")
+    except (ServeError, OSError, ValueError):
+        pass
 
     completed = [r for r in per_job if r is not None]
     if not completed:
@@ -217,6 +253,11 @@ def run_loadtest(port: int, paths: dict, jobs: int, clients: int,
         # (pool is None when the daemon ran without a fleet plane)
         "pool": pool_series(stats_samples),
         "curve": saturation_curve(completed, stats_samples, makespan),
+        # aggregated latency ledger over the completed jobs (where the
+        # wall went, stage by stage) + the daemon's per-tenant SLO
+        # snapshot scraped at the end of the run
+        "ledger": joblog.summarize(r.get("ledger") for r in completed),
+        "slo": slo_snap,
         "per_job": completed,
     }
     return summary
@@ -393,6 +434,9 @@ def main(argv=None) -> int:
                    help="spawned daemon's queued-job admission cap")
     p.add_argument("--max-jobs", type=int, default=None,
                    help="spawned daemon's unfinished-job admission cap")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="spawned daemon's Prometheus-text HTTP port "
+                   "(0 disables; lets CI scrape /metrics mid-run)")
     p.add_argument("--port", type=int, default=None,
                    help="drive an already-running daemon on this port "
                    "(default: spawn a fresh one)")
@@ -429,7 +473,8 @@ def main(argv=None) -> int:
     for flag, val in (("--fleet-max", args.fleet_max),
                       ("--fleet-min", args.fleet_min),
                       ("--queue-depth", args.queue_depth),
-                      ("--max-jobs", args.max_jobs)):
+                      ("--max-jobs", args.max_jobs),
+                      ("--metrics-port", args.metrics_port)):
         if val is not None:
             extra += [flag, str(val)]
     profiles = None

@@ -107,6 +107,7 @@ PROTOCOL = {
                   "resp": ("jobs", "queued", "queue_depth", "max_jobs",
                            "window_budget", "session", "telemetry",
                            "admission", "fleet")},
+        "metrics": {"req": (), "opt": (), "resp": ("text", "slo")},
         "shutdown": {"req": (), "opt": (), "resp": ("bye",)},
     },
     "distrib": {

@@ -74,6 +74,8 @@ from typing import Dict, List, Optional
 
 from .. import fingerprint, obs
 from ..fleet import fleet_tenant_quota
+from ..obs import ledger as joblog
+from ..obs import slo
 from ..resilience import budget as membudget
 from ..fleet.queues import TenantQueues
 from .session import (JobCancelled, JobSpec, PolishSession, serve_max_jobs,
@@ -132,6 +134,10 @@ class Job:
         self.t_submit = time.monotonic()
         self.t_start: Optional[float] = None
         self.t_end: Optional[float] = None
+        # per-job latency ledger (obs/ledger.py): stamps submit now;
+        # the scheduler stamps admit/dispatch/finish/result_ship as the
+        # job moves, the compute side ships stage_s fragments back
+        self.ledger = joblog.JobLedger(job_id, tenant=spec.submitter)
 
     def as_status(self) -> dict:
         now = time.monotonic()
@@ -327,6 +333,11 @@ class Scheduler:
                 spec.job_id = job_id
             job = Job(spec, job_id)
             lane = self._admission_lane(job, est, mem)
+            job.ledger.mark("admit")
+            # instant event: critpath's job-wall anchor in the merged
+            # fleet trace (pairs with serve.job.done in _finish)
+            obs.event("serve.job.submit", job=job_id,
+                      tenant=spec.submitter, lane=lane)
             self._jobs[job_id] = job
             self._enqueue(lane, job)
             self._persist_spec(job)
@@ -406,6 +417,14 @@ class Scheduler:
                        f"(RACON_TPU_MEM_BUDGET_MB="
                        f"{membudget.budget_mb()})")
             self._admission_count("shed_memory")
+        elif slo.engine().should_shed(spec.submitter):
+            # SLO shed: the tenant's burn rate exceeds the shedding
+            # threshold on both windows — stop piling work onto the
+            # lane that is missing its targets (opt-in, default off)
+            to_host = (f"shed (slo): burn rate over RACON_TPU_SLO_"
+                       f"SHED_BURN={slo.engine().shed_burn:g} on both "
+                       f"windows")
+            self._admission_count("shed_slo")
         elif budget > 0 and est is not None:
             if est > budget:
                 to_host = (f"window budget: ~{est} windows > "
@@ -525,6 +544,7 @@ class Scheduler:
                 job.state = "running"
                 job.lane = lane
                 job.t_start = time.monotonic()
+                job.ledger.mark("dispatch")
             if lane == "device" and self.plane is not None:
                 # elastic fleet path: hand the job to the plane and go
                 # straight back to the queue — several jobs in flight at
@@ -606,6 +626,13 @@ class Scheduler:
 
     def _finish(self, job: Job, state: str, result: Optional[dict] = None,
                 error: Optional[str] = None) -> None:
+        job.ledger.mark("finish")
+        if result is not None:
+            self._fold_ledger(job, result)
+            # the persisted copy cannot time its own write: result.json
+            # carries the ledger without the result_ship stage; the wire
+            # copy below is re-finalized after the persist
+            result["ledger"] = job.ledger.as_dict()
         with self._cv:
             self._reserved.pop(job.id, None)
             job.state = state
@@ -615,9 +642,33 @@ class Scheduler:
         # persist before signalling done: a waiter released by done.wait()
         # must find result.json on disk (clients read it immediately)
         self._persist_result(job)
+        job.ledger.mark("result_ship")
+        if result is not None:
+            result["ledger"] = job.ledger.as_dict()
+        obs.event("serve.job.done", job=job.id, tenant=job.spec.submitter,
+                  state=state)
+        if state != "cancelled":
+            # SLO ingest: a cancel is a client decision, not a miss
+            slo.engine().record(
+                job.spec.submitter,
+                (job.t_end or time.monotonic()) - job.t_submit,
+                ok=(state == "done"))
         with self._cv:
             job.done.set()
             self._cv.notify_all()
+
+    @staticmethod
+    def _fold_ledger(job: Job, result: dict) -> None:
+        """Absorb the compute side's stage durations into the job
+        ledger: a fleet-plane result carries a pre-aggregated
+        ``ledger.stage_s`` fragment; an in-process or host-lane result
+        carries the run-report summary."""
+        frag = result.get("ledger")
+        if isinstance(frag, dict) and isinstance(frag.get("stage_s"), dict):
+            job.ledger.merge_stage_s(frag["stage_s"])
+        elif isinstance(result.get("summary"), dict):
+            job.ledger.merge_stage_s(
+                joblog.stage_seconds(result["summary"]))
 
     # -- host lane ---------------------------------------------------------
 
@@ -686,11 +737,16 @@ class Scheduler:
                 else:
                     polished_bp += len(line.strip())
         replayed = 0
+        stage_s: Dict[str, float] = {}
         try:
             with open(report_path) as f:
                 rep = json.load(f)
             replayed = sum(ph.get("served", {}).get("journal", 0)
                            for ph in rep.get("phases", {}).values())
+            # report phases carry per-tier wall splits — the same shape
+            # RunReport.summary() ships, so the ledger fragment comes
+            # straight off the subprocess's own report
+            stage_s = joblog.stage_seconds(rep.get("phases"))
         except (OSError, json.JSONDecodeError, AttributeError):
             pass
         return {
@@ -706,6 +762,7 @@ class Scheduler:
             "report": report_path,
             "trace": os.path.join(jd, "trace.json"),
             "summary": None,
+            "ledger": {"stage_s": stage_s},
         }
 
     # -- persistence (job dir = crash-safe source of truth) ----------------

@@ -10,6 +10,8 @@ One JSON object per line in each direction.  Requests carry an ``op``:
   connection's thread only) until the job finishes or ``timeout``.
 * ``cancel``   -> cancel queued immediately / running best-effort.
 * ``stats``    -> scheduler + session counters.
+* ``metrics``  -> Prometheus text exposition + SLO engine snapshot
+  (the same text ``--metrics-port`` serves over HTTP).
 * ``shutdown`` -> acknowledge, then stop the daemon gracefully.
 
 Errors never kill the daemon: a malformed line gets
@@ -34,6 +36,9 @@ import sys
 import threading
 from typing import Optional
 
+from .. import config, obs
+from ..obs import export as obs_export
+from ..obs import slo
 from .protocol import MAX_LINE, read_message, write_message  # noqa: F401
 # (MAX_LINE is re-exported: it is this daemon's documented protocol
 # bound and pre-protocol.py importers reference it from here)
@@ -52,7 +57,8 @@ class ServeDaemon:
                  warm_scores=(3, -5, -4),
                  host_lane: bool = True,
                  fleet_min: Optional[int] = None,
-                 fleet_max: Optional[int] = None):
+                 fleet_max: Optional[int] = None,
+                 metrics_port: Optional[int] = None):
         self.state_dir = state_dir
         os.makedirs(state_dir, exist_ok=True)
         self.session = PolishSession(state_dir, backend=backend)
@@ -88,6 +94,12 @@ class ServeDaemon:
         self.port = self._sock.getsockname()[1]
         self._accept_thread: Optional[threading.Thread] = None
         self._stopping = threading.Event()
+        # Prometheus exposition endpoint (obs/export.py): 0 = disabled;
+        # the `metrics` wire op serves the same text either way
+        self.metrics_port = (config.get_int("RACON_TPU_METRICS_PORT")
+                             if metrics_port is None else metrics_port)
+        self._httpd = None
+        self._httpd_thread: Optional[threading.Thread] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -126,6 +138,7 @@ class ServeDaemon:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="serve-accept", daemon=True)
         self._accept_thread.start()
+        self._start_metrics_http()
 
     def serve_forever(self) -> None:
         self.start()
@@ -143,6 +156,7 @@ class ServeDaemon:
                 self._sock.close()
             except OSError:
                 pass
+            self._stop_metrics_http()
         if wait:
             self.scheduler.shutdown(wait=True)
             self._stop_plane()
@@ -154,6 +168,80 @@ class ServeDaemon:
             return
         self.plane.phase.extra["admission"] = dict(self.scheduler.admission)
         self.plane.stop()
+
+    # -- metrics exposition -------------------------------------------------
+
+    def _metrics_scrape(self) -> dict:
+        """One scrape: obs registry snapshot (None when disarmed) + SLO
+        engine state + instantaneous queue/fleet gauges, rendered as
+        Prometheus text (obs/export.py).  Shared by the `metrics` wire
+        op and the --metrics-port HTTP endpoint."""
+        st = self.scheduler.stats()   # plane lock + _cv, never nested
+        gauges = {
+            "serve_queued_jobs": sum(st.get("queued", {}).values()),
+            "serve_running_jobs": st.get("jobs", {}).get("running", 0),
+        }
+        fleet = st.get("fleet")
+        if isinstance(fleet, dict):
+            workers = fleet.get("workers")
+            # plane snapshots expose {"live": n, "active": n, "dead": n}
+            if isinstance(workers, dict):
+                live = workers.get("live")
+                if isinstance(live, (int, float)):
+                    gauges["fleet_live_workers"] = live
+            elif isinstance(workers, (int, float)):
+                gauges["fleet_live_workers"] = workers
+        snap = slo.engine().snapshot()
+        return {"text": obs_export.prometheus_text(
+                    metrics=obs.snapshot(), slo=snap, gauges=gauges),
+                "slo": snap}
+
+    def _start_metrics_http(self) -> None:  # concurrency: _httpd set once before the accept loop starts
+        """Optional localhost HTTP exposition (`GET /metrics`); the
+        stdlib threading server keeps the daemon dependency-free."""
+        if not self.metrics_port or self.metrics_port <= 0:
+            return
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        daemon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):              # noqa: N802 — stdlib contract
+                if self.path.split("?")[0].rstrip("/") not in ("",
+                                                               "/metrics"):
+                    self.send_error(404)
+                    return
+                body = daemon._metrics_scrape()["text"].encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes are not log lines
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.metrics_port),
+                                          Handler)
+        self._httpd.daemon_threads = True
+        self.metrics_port = self._httpd.server_address[1]
+        self._httpd_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serve-metrics-http",
+            daemon=True)
+        self._httpd_thread.start()
+        print(f"[racon_tpu::serve] metrics exposition on "
+              f"http://127.0.0.1:{self.metrics_port}/metrics",
+              file=sys.stderr)
+
+    def _stop_metrics_http(self) -> None:  # concurrency: atomic swap; a double stop gets None and no-ops
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            try:
+                httpd.shutdown()
+                httpd.server_close()
+            except OSError:
+                pass
 
     # -- accept / connection handling --------------------------------------
 
@@ -237,7 +325,9 @@ class ServeDaemon:
                     **self.scheduler.cancel(str(req["job_id"]))}
         if op == "stats":
             return {"ok": True, **self.scheduler.stats()}
+        if op == "metrics":
+            return {"ok": True, **self._metrics_scrape()}
         if op == "shutdown":
             return {"ok": True, "bye": True}
         raise ValueError(f"unknown op {op!r}; expected one of ping/submit/"
-                         f"status/result/cancel/stats/shutdown")
+                         f"status/result/cancel/stats/metrics/shutdown")

@@ -29,7 +29,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from .. import config, fingerprint, obs
-from ..obs import context, flight
+from ..obs import context, flight, ledger
 from ..polisher import create_polisher
 
 #: Polish parameters a job may override, with the CLI defaults — the
@@ -271,6 +271,14 @@ class PolishSession:
             with open(out_path, "w") as f:
                 for name, data in out:
                     f.write(f">{name}\n{data}\n")
+            summary = polisher.report.summary()
+            # compute-side latency-ledger fragment: per-stage seconds
+            # from this run's own report plus the build/replay overlays,
+            # persisted with the report and shipped in the result for
+            # the scheduler's job ledger
+            stage_s = ledger.stage_seconds(summary)
+            stage_s.update(ledger.overlay_seconds(obs.snapshot()))
+            polisher.report.ledger = {"job": job_id, "stage_s": stage_s}
             report_doc = dict(polisher.report.as_dict())
             report_doc["job_id"] = job_id
             with open(report_path, "w") as f:
@@ -296,7 +304,8 @@ class PolishSession:
                 "report": report_path,
                 "trace": trace_path,
                 "obs": ship,
-                "summary": polisher.report.summary(),
+                "summary": summary,
+                "ledger": {"stage_s": dict(stage_s)},
             }
         except JobCancelled:
             raise

@@ -374,7 +374,9 @@ def test_committed_history_is_clean():
 def test_hist_quantile_log2_buckets():
     h = {"count": 4, "sum": 1041.0, "max": 1000.0,
          "buckets": {"1": 1, "8": 2, "1024": 1}}
-    assert hist_quantile(h, 0.5) == 8.0
+    # the crossing lands halfway into the (4, 8] bucket: interpolated
+    # 6.0, where the old estimator snapped to the upper bound (8.0)
+    assert hist_quantile(h, 0.5) == 6.0
     assert hist_quantile(h, 0.99) == 1000.0     # clamped to observed max
     assert hist_quantile({"count": 0, "buckets": {}}, 0.5) is None
     assert hist_quantile({}, 0.5) is None

@@ -305,6 +305,8 @@ def test_bench_distrib_entry_normalizes_as_fixed_point():
         "fleet": {"workers": {"0": {"chunks": 6}},
                   "queueing_p95_s": 0.01, "staleness_max_s": 0.2},
         "pool": {"min": 3, "max": 3, "timeline": [[0.0, 3]]},
+        "ledger": {"stage_s": {"align": 0.2, "poa": 0.5}},
+        "slo": None,
         "mbp": 0.5, "input": "paf", "profile": "distrib-ont",
     }
     assert normalize_entry(dict(entry)) == entry
@@ -317,6 +319,10 @@ def test_bench_distrib_entry_normalizes_as_fixed_point():
     # pre-elastic-pool entries get the explicit "no timeline" null
     legacy = {k: v for k, v in entry.items() if k != "pool"}
     assert normalize_entry(legacy)["pool"] is None
+    # pre-ledger / pre-SLO entries get the explicit nulls too
+    legacy = {k: v for k, v in entry.items() if k not in ("ledger", "slo")}
+    normalized = normalize_entry(legacy)
+    assert normalized["ledger"] is None and normalized["slo"] is None
 
 
 # ------------------------------------------------ integration: real fleets

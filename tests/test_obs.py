@@ -19,7 +19,7 @@ import pytest
 import racon_tpu
 from racon_tpu import obs
 from racon_tpu.obs import __main__ as obs_cli
-from racon_tpu.obs.metrics import Histogram, Metrics
+from racon_tpu.obs.metrics import Histogram, Metrics, hist_quantile
 from racon_tpu.obs.tracer import NULL_SPAN, Tracer
 
 
@@ -578,3 +578,131 @@ def test_telemetry_ring_bounded(monkeypatch):
     assert ring[-1]["queue_depth"] == 9
     assert obs.telemetry(last=2) == ring[-2:]
     o._telemetry = None
+
+
+# ------------------------------------------- hist_quantile interpolation
+
+def test_hist_quantile_interpolates_within_bucket():
+    h = Histogram()
+    for _ in range(50):
+        h.observe(3.0)
+    for _ in range(50):
+        h.observe(3.5)
+    d = h.as_dict()
+    # all values share the (2, 4] bucket; the old estimator returned
+    # the bucket's upper bound (4.0) for every quantile
+    p50 = hist_quantile(d, 0.5)
+    assert 3.0 <= p50 <= 3.5          # clamped to observed [min, max]
+    assert p50 < 4.0
+    # monotone in q
+    qs = [hist_quantile(d, q) for q in (0.5, 0.9, 0.99)]
+    assert qs == sorted(qs)
+    # the "0" bucket holds only <= 0 values
+    z = Histogram()
+    z.observe(0.0)
+    z.observe(-1.0)
+    assert hist_quantile(z.as_dict(), 0.99) == 0.0
+    # empty / malformed -> None, never a crash
+    assert hist_quantile(Histogram().as_dict(), 0.5) is None
+    assert hist_quantile({"count": "x"}, 0.5) is None
+    assert hist_quantile("nope", 0.5) is None
+
+
+def test_hist_quantile_error_bounded_by_bucket_width():
+    """The estimate and the exact rank quantile share the winning log2
+    bucket, so |est - exact| is bounded by that bucket's width."""
+    import math
+
+    rng = random.Random(20)
+    vals = [rng.uniform(0.001, 900.0) for _ in range(500)]
+    h = Histogram()
+    for v in vals:
+        h.observe(v)
+    d = h.as_dict()
+    s = sorted(vals)
+    for q in (0.5, 0.9, 0.99):
+        est = hist_quantile(d, q)
+        exact = s[max(1, math.ceil(q * len(s))) - 1]
+        hi = float(2 ** max(0, math.ceil(math.log2(exact))))
+        width = hi - (hi / 2.0 if hi >= 2.0 else 0.0)
+        assert abs(est - exact) <= width + 1e-9, (q, est, exact, width)
+
+
+# --------------------------------------- epoch re-basing: ingest + merge
+
+def test_export_ingest_negative_epoch_delta_clamps(tmp_path):
+    """A worker whose monotonic epoch PREDATES the coordinator's (it
+    booted first) re-bases to a negative delta: events from before the
+    coordinator's epoch clamp to ts 0 instead of going negative (the
+    Chrome-trace schema and the validator both require ts >= 0)."""
+    coord = Tracer()
+    worker = Tracer()
+    worker.pid = coord.pid + 1
+    worker.role = "worker_old"
+    worker._t0 = coord.t0_ns - 3_000_000       # worker booted 3ms earlier
+    worker.add_complete("early", worker.t0_ns,
+                        worker.t0_ns + 1_000)  # before coord's epoch
+    worker.add_complete("late", worker.t0_ns + 5_000_000,
+                        worker.t0_ns + 5_001_000)
+    ship = worker.export(max_events=10)
+    assert coord.ingest(ship) == 2
+    doc = coord.to_dict()
+    by_name = {e["name"]: e for e in doc["traceEvents"]
+               if e.get("name") in ("early", "late")}
+    assert by_name["early"]["ts"] == 0         # clamped, not negative
+    assert by_name["late"]["ts"] == 2000       # -3ms + 5ms = +2ms in µs
+    path = tmp_path / "clamped.json"
+    path.write_text(json.dumps(doc))
+    assert obs_cli.main(["--validate", str(path)]) == 0
+
+
+def test_cli_merge_worker_epoch_predating_coordinator(tmp_path):
+    """merge re-bases onto the OLDEST known epoch, so a worker that
+    booted before the coordinator keeps its early events at small
+    positive ts and the coordinator's events shift right."""
+    a = Tracer()
+    a.role = "coordinator"
+    a.add_instant("coord.mark")
+    b = Tracer()
+    b.pid = a.pid + 1
+    b.role = "worker0"
+    b._t0 = a.t0_ns - 5_000_000                # worker epoch 5ms earlier
+    b.add_complete("distrib.chunk", b.t0_ns, b.t0_ns + 1000)
+    pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    a.write(pa)
+    b.write(pb)
+    merged = str(tmp_path / "m.json")
+    assert obs_cli.main(["merge", "--out", merged, pa, pb]) == 0
+    assert obs_cli.main(["--validate", merged]) == 0
+    doc = json.load(open(merged))
+    chunk = [e for e in doc["traceEvents"]
+             if e.get("name") == "distrib.chunk"][0]
+    mark = [e for e in doc["traceEvents"]
+            if e.get("name") == "coord.mark"][0]
+    assert chunk["ts"] == 0                    # worker owns the base epoch
+    assert mark["ts"] >= 5000                  # coordinator shifted +5ms
+
+
+def test_cli_merge_doc_without_epoch_keeps_own_timebase(tmp_path):
+    """A trace doc with no epoch stamp (foreign/hand-built) cannot be
+    re-based: merge keeps its own timebase instead of guessing."""
+    a = Tracer()
+    a.role = "coordinator"
+    a.add_instant("coord.mark")
+    pa = str(tmp_path / "a.json")
+    a.write(pa)
+    bare = {
+        "traceEvents": [
+            {"name": "foreign.span", "ph": "X", "ts": 7, "dur": 3,
+             "pid": 999, "tid": 1, "cat": "racon_tpu", "args": {}},
+        ],
+        "displayTimeUnit": "ms",
+    }
+    pb = str(tmp_path / "bare.json")
+    json.dump(bare, open(pb, "w"))
+    merged = str(tmp_path / "m.json")
+    assert obs_cli.main(["merge", "--out", merged, pa, pb]) == 0
+    doc = json.load(open(merged))
+    foreign = [e for e in doc["traceEvents"]
+               if e.get("name") == "foreign.span"][0]
+    assert foreign["ts"] == 7                  # untouched: no epoch known

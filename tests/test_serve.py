@@ -540,9 +540,11 @@ def test_loadtest_percentile_and_docs_block(tmp_path):
 
     assert loadtest.percentile([1.0], 99) == 1.0
     vals = [float(i) for i in range(1, 101)]
-    assert loadtest.percentile(vals, 50) == 50.0
-    assert loadtest.percentile(vals, 95) == 95.0
-    assert loadtest.percentile(vals, 99) == 99.0
+    # linearly interpolated (same estimator as obs critpath): p50 of 1..100
+    # sits halfway between the 50th and 51st order statistics.
+    assert loadtest.percentile(vals, 50) == 50.5
+    assert loadtest.percentile(vals, 95) == 95.05
+    assert loadtest.percentile(vals, 99) == 99.01
 
     summary = {
         "jobs": 4, "clients": 2, "throughput_mbps": 0.5,
@@ -583,6 +585,9 @@ def test_bench_serve_entry_normalizes_as_fixed_point():
                   "latency_s": {"p50": 1, "p95": 2, "p99": 3}},
         "fleet": {"samples": 3, "max_queued": 2, "last": None},
         "pool": {"min": 1, "max": 3, "timeline": [[0.0, 1], [1.5, 3]]},
+        "ledger": {"jobs": 4, "stage_s": {"queue": 0.5},
+                   "wall_s": 2.0, "unattributed_s": 0.1},
+        "slo": {"counters": {"observed": 4, "bad": 0}},
         "mbp": 0.5, "input": "paf", "profile": "serve-ont",
     }
     assert normalize_entry(dict(entry)) == entry
@@ -595,6 +600,10 @@ def test_bench_serve_entry_normalizes_as_fixed_point():
     # pre-elastic-pool entries get the explicit "no timeline" null
     legacy = {k: v for k, v in entry.items() if k != "pool"}
     assert normalize_entry(legacy)["pool"] is None
+    # pre-ledger / pre-SLO entries get the explicit nulls too
+    legacy = {k: v for k, v in entry.items() if k not in ("ledger", "slo")}
+    normalized = normalize_entry(legacy)
+    assert normalized["ledger"] is None and normalized["slo"] is None
 
 
 def test_cli_serve_subcommand_dispatches():
